@@ -1,0 +1,20 @@
+"""dlrover_tpu: a TPU-native elastic-training framework.
+
+A brand-new framework with the capabilities of DLRover (reference:
+``dlrover/python`` + ``atorch``), re-designed TPU-first:
+
+- Control plane: a centralized per-job **master** (rendezvous, dynamic data
+  sharding, health/straggler diagnostics, auto-scaling) with thin node
+  **agents** — the same load-bearing design as the reference
+  (``dlrover/python/master/dist_master.py``), re-implemented for JAX jobs.
+- Data plane: pure JAX — a ``jax.sharding.Mesh`` of named axes
+  (``data``/``fsdp``/``tensor``/``sequence``/``expert``/``pipe``) replaces the
+  reference's torch process-group zoo; collectives ride ICI/DCN via XLA.
+- Acceleration: ``auto_accelerate`` lowers a named strategy onto the mesh
+  (reference: ``atorch/auto/accelerate.py``), with Pallas kernels for the hot
+  ops (flash attention, fused norms, quantization).
+- Elasticity: master-backed rendezvous re-forms the world; training restarts
+  re-lower to the new mesh and restore resharded checkpoints.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,266 @@
+"""MasterClient: the sole channel from a node to the master.
+
+Capability parity: dlrover/python/elastic_agent/master_client.py:49 — typed
+wrappers over the 2-RPC service for every protocol interaction, with a retry
+decorator, plus the singleton builder that reads the master address from the
+env contract.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import MasterStub, build_channel, local_ip
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def retry_rpc(retries: int = 10, backoff_s: float = 1.0):
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            last_exc = None
+            for attempt in range(retries):
+                try:
+                    return fn(*args, **kwargs)
+                except Exception as exc:  # noqa: BLE001 — grpc errors vary
+                    last_exc = exc
+                    time.sleep(backoff_s * min(attempt + 1, 5))
+            raise last_exc
+
+        return wrapped
+
+    return decorator
+
+
+class MasterClient:
+    _singleton: Optional["MasterClient"] = None
+
+    def __init__(self, master_addr: str, node_id: int = 0,
+                 node_rank: Optional[int] = None, timeout_s: float = 30.0):
+        self.master_addr = master_addr
+        self.node_id = node_id
+        self.node_rank = node_rank if node_rank is not None else node_id
+        self._timeout_s = timeout_s
+        self._channel = build_channel(master_addr)
+        self._stub = MasterStub(self._channel)
+
+    # -- raw --------------------------------------------------------------
+    def _get(self, request: msg.Message) -> msg.Message:
+        data = self._stub.get(msg.serialize_message(request),
+                              timeout=self._timeout_s)
+        return msg.deserialize_message(data)
+
+    def _get_typed(self, request: msg.Message, expected: type) -> msg.Message:
+        """`get` that enforces the response type — a generic failure Response
+        becomes a raisable (and retryable) error instead of an
+        AttributeError in the caller."""
+        response = self._get(request)
+        if not isinstance(response, expected):
+            reason = getattr(response, "reason", repr(response))
+            raise RuntimeError(
+                f"master error for {type(request).__name__}: {reason}"
+            )
+        return response
+
+    def _report(self, request: msg.Message) -> msg.Message:
+        data = self._stub.report(msg.serialize_message(request),
+                                 timeout=self._timeout_s)
+        return msg.deserialize_message(data)
+
+    def close(self) -> None:
+        self._channel.close()
+
+    # -- dynamic sharding -------------------------------------------------
+    @retry_rpc()
+    def report_dataset_shard_params(self, params: msg.DatasetShardParams
+                                    ) -> bool:
+        return self._report(params).success
+
+    @retry_rpc(retries=3)
+    def get_task(self, dataset_name: str) -> msg.Task:
+        return self._get_typed(
+            msg.TaskRequest(dataset_name=dataset_name,
+                            worker_id=self.node_id),
+            msg.Task,
+        )
+
+    @retry_rpc(retries=3)
+    def report_task_result(self, dataset_name: str, task_id: int,
+                           success: bool = True, err: str = "") -> bool:
+        return self._report(msg.TaskResult(
+            dataset_name=dataset_name, task_id=task_id,
+            worker_id=self.node_id, success=success, err_message=err,
+        )).success
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        result = self._get_typed(
+            msg.ShardCheckpointRequest(dataset_name=dataset_name),
+            msg.ShardCheckpoint,
+        )
+        return result.content
+
+    def report_shard_checkpoint(self, content: str) -> bool:
+        return self._report(msg.ShardCheckpoint(content=content)).success
+
+    def get_dataset_epoch(self, dataset_name: str) -> int:
+        return self._get_typed(
+            msg.DatasetEpochInfo(dataset_name=dataset_name),
+            msg.DatasetEpochInfo,
+        ).epoch
+
+    # -- rendezvous -------------------------------------------------------
+    @retry_rpc()
+    def join_rendezvous(self, local_world_size: int,
+                        rdzv_name: str = RendezvousName.TRAINING) -> bool:
+        return self._report(msg.JoinRendezvousRequest(
+            node_id=self.node_id,
+            node_rank=self.node_rank,
+            local_world_size=local_world_size,
+            rdzv_name=rdzv_name,
+            node_ip=local_ip(),
+        )).success
+
+    @retry_rpc(retries=3)
+    def get_comm_world(self, rdzv_name: str = RendezvousName.TRAINING
+                       ) -> Tuple[int, int, Dict[int, int]]:
+        world = self._get_typed(
+            msg.CommWorldRequest(node_id=self.node_rank,
+                                 rdzv_name=rdzv_name),
+            msg.CommWorld,
+        )
+        return world.round, world.group, world.world
+
+    @retry_rpc(retries=3)
+    def num_nodes_waiting(self, rdzv_name: str = RendezvousName.TRAINING
+                          ) -> int:
+        result = self._get_typed(
+            msg.WaitingNodeNumRequest(node_id=self.node_rank,
+                                      rdzv_name=rdzv_name),
+            msg.WaitingNodeNum,
+        )
+        return result.waiting_num
+
+    def report_network_status(self, normal: bool, elapsed: float) -> bool:
+        return self._report(msg.NetworkStatusReport(
+            node_id=self.node_rank, normal=normal, elapsed_time=elapsed,
+        )).success
+
+    def get_network_check_verdict(self) -> msg.NetworkCheckVerdict:
+        return self._get_typed(
+            msg.NetworkCheckResultRequest(node_id=self.node_rank),
+            msg.NetworkCheckVerdict,
+        )
+
+    # -- kv store ---------------------------------------------------------
+    def kv_set(self, key: str, value: bytes) -> bool:
+        return self._report(msg.KeyValuePair(key=key, value=value)).success
+
+    def kv_get(self, key: str) -> bytes:
+        return self._get_typed(msg.KVGetRequest(key=key),
+                               msg.KeyValuePair).value
+
+    def kv_add(self, key: str, amount: int) -> int:
+        return self._report(msg.KVAddRequest(key=key, amount=amount)).value
+
+    def kv_wait(self, key: str, timeout_s: float = 300.0) -> bytes:
+        """Block until the key appears: the master holds each RPC open on a
+        condition variable (KVWaitRequest) for up to ~20 s per window."""
+        deadline = time.time() + timeout_s
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                raise TimeoutError(f"kv_wait timed out on {key!r}")
+            result = self._get(msg.KVWaitRequest(
+                keys=[key], timeout_s=min(remaining, 20.0)))
+            if getattr(result, "success", False):
+                return self.kv_get(key)
+
+    # -- health / status --------------------------------------------------
+    def report_global_step(self, step: int) -> bool:
+        return self._report(msg.GlobalStepReport(
+            node_id=self.node_id, step=step, timestamp=time.time(),
+        )).success
+
+    def report_resource_stats(self, stats: msg.NodeResourceStats) -> bool:
+        return self._report(stats).success
+
+    def report_heartbeat(self) -> bool:
+        return self._report(msg.NodeHeartbeat(
+            node_id=self.node_id, timestamp=time.time())).success
+
+    def report_failure(self, error_data: str, level: str,
+                       restart_count: int = 0) -> bool:
+        return self._report(msg.NodeFailureReport(
+            node_id=self.node_id, node_rank=self.node_rank,
+            error_data=error_data, level=level,
+            restart_count=restart_count,
+        )).success
+
+    def report_node_address(self, addr: str) -> bool:
+        return self._report(msg.NodeAddressReport(
+            node_id=self.node_id, node_rank=self.node_rank, addr=addr,
+        )).success
+
+    def get_paral_config(self) -> msg.ParallelConfig:
+        return self._get_typed(
+            msg.ParallelConfigRequest(node_id=self.node_id),
+            msg.ParallelConfig,
+        )
+
+    def get_job_status(self) -> msg.JobStatus:
+        return self._get_typed(msg.JobStatusRequest(), msg.JobStatus)
+
+    # -- barriers / PS versions -------------------------------------------
+    def join_sync(self, sync_name: str) -> bool:
+        return self._report(msg.SyncJoinRequest(
+            sync_name=sync_name, node_id=self.node_id)).success
+
+    def sync_finished(self, sync_name: str) -> bool:
+        return self._get(msg.SyncQueryRequest(sync_name=sync_name)).success
+
+    def finish_sync(self, sync_name: str) -> bool:
+        return self._report(
+            msg.SyncFinishRequest(sync_name=sync_name)).success
+
+    def update_cluster_version(self, version_type: str, version: int,
+                               task_type: str = "worker",
+                               task_id: Optional[int] = None) -> bool:
+        return self._report(msg.ClusterVersionRequest(
+            task_type=task_type,
+            task_id=task_id if task_id is not None else self.node_id,
+            version_type=version_type, version=version,
+        )).success
+
+    def get_cluster_version(self, version_type: str,
+                            task_type: str = "worker",
+                            task_id: Optional[int] = None) -> int:
+        return self._get_typed(msg.ClusterVersionRequest(
+            task_type=task_type,
+            task_id=task_id if task_id is not None else self.node_id,
+            version_type=version_type,
+        ), msg.ClusterVersion).version
+
+    # -- singleton --------------------------------------------------------
+    @classmethod
+    def singleton(cls) -> "MasterClient":
+        if cls._singleton is None:
+            addr = os.getenv(NodeEnv.MASTER_ADDR, "")
+            if not addr:
+                raise RuntimeError(
+                    f"{NodeEnv.MASTER_ADDR} is not set; is this process "
+                    "running under dlrover-tpu-run?"
+                )
+            node_id = int(os.getenv(NodeEnv.NODE_ID, "0"))
+            node_rank = int(os.getenv(NodeEnv.NODE_RANK, str(node_id)))
+            cls._singleton = cls(addr, node_id, node_rank)
+        return cls._singleton
+
+    @classmethod
+    def reset_singleton(cls) -> None:
+        cls._singleton = None
+

@@ -1,0 +1,132 @@
+"""gRPC plumbing for the 2-RPC control plane.
+
+Capability parity: dlrover/python/common/grpc.py (`build_channel` :30, retry
+policy :41-48) + dlrover/proto/elastic_training.proto (the 2-method service).
+Instead of protoc-generated stubs, the service is registered through gRPC's
+generic-handler API with raw-bytes (de)serializers; payloads are the typed
+dataclasses of dlrover_tpu.common.messages.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from concurrent import futures
+from typing import Callable, Optional, Tuple
+
+import grpc
+
+from dlrover_tpu.common.constants import DefaultValues
+
+SERVICE_NAME = "dlrovertpu.Master"
+GET_METHOD = f"/{SERVICE_NAME}/get"
+REPORT_METHOD = f"/{SERVICE_NAME}/report"
+
+_MAX_MESSAGE_BYTES = DefaultValues.GRPC_MAX_MESSAGE_MB * 1024 * 1024
+
+_RETRY_POLICY = json.dumps({
+    "methodConfig": [{
+        "name": [{"service": SERVICE_NAME}],
+        "retryPolicy": {
+            "maxAttempts": 5,
+            "initialBackoff": "0.2s",
+            "maxBackoff": "3s",
+            "backoffMultiplier": 2,
+            "retryableStatusCodes": ["UNAVAILABLE"],
+        },
+    }]
+})
+
+
+def _identity(data: bytes) -> bytes:
+    return data
+
+
+def build_channel(addr: str) -> grpc.Channel:
+    options = [
+        ("grpc.max_send_message_length", _MAX_MESSAGE_BYTES),
+        ("grpc.max_receive_message_length", _MAX_MESSAGE_BYTES),
+        ("grpc.enable_retries", 1),
+        ("grpc.service_config", _RETRY_POLICY),
+    ]
+    return grpc.insecure_channel(addr, options=options)
+
+
+def addr_connectable(addr: str, timeout_s: float = 2.0) -> bool:
+    host, _, port = addr.rpartition(":")
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)),
+                                      timeout=timeout_s):
+            return True
+    except OSError:
+        return False
+
+
+class MasterStub:
+    """Client-side stub over the generic channel."""
+
+    def __init__(self, channel: grpc.Channel):
+        self._get = channel.unary_unary(
+            GET_METHOD, request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+        self._report = channel.unary_unary(
+            REPORT_METHOD, request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+
+    def get(self, payload: bytes, timeout: Optional[float] = None) -> bytes:
+        return self._get(payload, timeout=timeout, wait_for_ready=True)
+
+    def report(self, payload: bytes,
+               timeout: Optional[float] = None) -> bytes:
+        return self._report(payload, timeout=timeout, wait_for_ready=True)
+
+
+def build_server(
+    get_fn: Callable[[bytes, grpc.ServicerContext], bytes],
+    report_fn: Callable[[bytes, grpc.ServicerContext], bytes],
+    port: int = 0,
+    host: str = "0.0.0.0",
+    max_workers: int = 64,
+) -> Tuple[grpc.Server, int]:
+    """Register the 2 methods and bind; returns (server, bound_port)."""
+    handlers = {
+        "get": grpc.unary_unary_rpc_method_handler(
+            get_fn, request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+        "report": grpc.unary_unary_rpc_method_handler(
+            report_fn, request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+    }
+    generic = grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_send_message_length", _MAX_MESSAGE_BYTES),
+            ("grpc.max_receive_message_length", _MAX_MESSAGE_BYTES),
+        ],
+    )
+    server.add_generic_rpc_handlers((generic,))
+    bound_port = server.add_insecure_port(f"{host}:{port}")
+    if bound_port == 0:
+        raise RuntimeError(f"cannot bind master port {port}")
+    return server, bound_port
+
+
+def local_ip() -> str:
+    """Best-effort routable address of this host."""
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def find_free_port() -> int:
+    sock = socket.socket()
+    sock.bind(("", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port

@@ -1,0 +1,77 @@
+"""Global context singleton of runtime tunables.
+
+Capability parity: dlrover/python/common/global_context.py — one place for
+timeouts, thresholds and ports, overridable via env vars (``DLROVER_TPU_<KEY>``)
+or programmatically (tests), and updatable at runtime from a resource-plan
+service (the Brain-equivalent) without restarting the master.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from dlrover_tpu.common.constants import DefaultValues
+
+
+class Context:
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.master_port: int = DefaultValues.MASTER_PORT
+        self.rdzv_timeout_s: float = DefaultValues.RDZV_TIMEOUT_S
+        self.rdzv_wait_new_node_s: float = DefaultValues.RDZV_WAIT_NEW_NODE_S
+        self.task_timeout_s: float = DefaultValues.TASK_TIMEOUT_S
+        self.heartbeat_interval_s: float = DefaultValues.HEARTBEAT_INTERVAL_S
+        self.hang_seconds: float = DefaultValues.HANG_SECONDS
+        self.max_relaunch: int = DefaultValues.MAX_RELAUNCH
+        self.kv_wait_timeout_s: float = DefaultValues.KV_WAIT_TIMEOUT_S
+        self.monitor_interval_s: float = DefaultValues.MONITOR_INTERVAL_S
+        self.report_resource_interval_s: float = (
+            DefaultValues.REPORT_RESOURCE_INTERVAL_S
+        )
+        self.speed_sample_window: int = DefaultValues.SPEED_SAMPLE_WINDOW
+        self.straggler_median_ratio: float = (
+            DefaultValues.STRAGGLER_MEDIAN_RATIO
+        )
+        self.seconds_per_scale_check: float = (
+            DefaultValues.SECONDS_PER_SCALE_CHECK
+        )
+        self.relaunch_on_worker_failure: bool = True
+        self.auto_scale_enabled: bool = False
+        self.network_check_enabled: bool = False
+        self._load_env_overrides()
+
+    def _load_env_overrides(self) -> None:
+        for name, value in vars(self).items():
+            if name.startswith("_"):
+                continue
+            env_key = f"DLROVER_TPU_{name.upper()}"
+            raw = os.getenv(env_key)
+            if raw is None:
+                continue
+            kind = type(value)
+            if kind is bool:
+                setattr(self, name, raw.lower() in ("1", "true", "yes"))
+            else:
+                setattr(self, name, kind(raw))
+
+    def update(self, **kwargs) -> None:
+        """Runtime override (e.g. from a resource-plan service)."""
+        for key, value in kwargs.items():
+            if hasattr(self, key) and not key.startswith("_"):
+                setattr(self, key, value)
+
+    @classmethod
+    def singleton(cls) -> "Context":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """For tests: drop the singleton so env overrides re-apply."""
+        with cls._lock:
+            cls._instance = None

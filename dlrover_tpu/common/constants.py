@@ -1,0 +1,138 @@
+"""Framework-wide constants.
+
+Capability parity with the reference's constant vocabulary
+(dlrover/python/common/constants.py) — node types, statuses, the env-var
+contract between master/agent/worker, rendezvous names, message levels —
+re-spelled for a TPU/JAX deployment (hosts own TPU chips; worker processes are
+JAX processes on TPU hosts).
+"""
+
+
+class PlatformType:
+    LOCAL = "local"          # single-machine dev: master + agents as processes
+    KUBERNETES = "k8s"       # GKE / k8s: pods per TPU host
+    RAY = "ray"
+
+
+class NodeType:
+    MASTER = "master"
+    WORKER = "worker"        # a TPU host running one JAX process
+    CHIEF = "chief"          # worker rank 0 (does checkpoint writes, logging)
+    EVALUATOR = "evaluator"
+    PS = "ps"                # parameter-server-style state holder (embeddings)
+
+
+class NodeStatus:
+    INITIAL = "initial"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+    UNKNOWN = "unknown"
+    BREAKDOWN = "breakdown"  # machine-level fault (host unreachable)
+
+    @classmethod
+    def terminal(cls):
+        return {cls.SUCCEEDED, cls.FAILED, cls.DELETED}
+
+
+class NodeEventType:
+    ADDED = "added"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+
+
+class NodeExitReason:
+    SUCCEEDED = "succeeded"
+    KILLED = "killed"            # deleted/preempted by the platform
+    OOM = "oom"                  # host or HBM out-of-memory
+    FATAL_ERROR = "fatal_error"  # un-relaunchable user error
+    HARDWARE_ERROR = "hardware_error"  # TPU chip / ICI fault
+    UNKNOWN_ERROR = "unknown_error"
+    RELAUNCHED = "relaunched"
+
+
+class JobStage:
+    CREATED = "created"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    STOPPING = "stopping"
+
+
+class RendezvousName:
+    TRAINING = "elastic-training"
+    NETWORK_CHECK = "network-check"
+
+
+class NodeEnv:
+    """Env-var contract (reference: constants.py NodeEnv / NodeEnv.DLROVER_MASTER_ADDR)."""
+
+    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_RANK = "DLROVER_TPU_NODE_RANK"
+    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    # Per-worker (set by the agent for the spawned training process):
+    WORLD_SIZE = "DLROVER_TPU_WORLD_SIZE"          # number of JAX processes
+    PROCESS_ID = "DLROVER_TPU_PROCESS_ID"          # jax process index
+    COORDINATOR_ADDR = "DLROVER_TPU_COORDINATOR"   # jax.distributed coordinator
+    RDZV_ROUND = "DLROVER_TPU_RDZV_ROUND"
+    PARAL_CONFIG_PATH = "DLROVER_TPU_PARAL_CONFIG" # tuned-config hot-reload file
+    DEVICES_PER_NODE = "DLROVER_TPU_DEVICES_PER_NODE"
+
+
+class TrainingMsgLevel:
+    PROCESS_ERROR = "process_error"
+    NODE_ERROR = "node_error"
+    RDZV_ERROR = "rdzv_error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+class TaskType:
+    """Dynamic-sharding task types (reference: master/shard)."""
+
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+    WAIT = "wait"
+    NONE = "none"
+
+
+class NetworkCheckResult:
+    NORMAL = "normal"
+    FAULT = "fault"
+    STRAGGLER = "straggler"
+
+
+class MeshAxis:
+    """Canonical named mesh axes (replaces the reference's named process groups,
+    atorch/distributed/distributed.py:323 create_parallel_group)."""
+
+    DATA = "data"
+    FSDP = "fsdp"
+    TENSOR = "tensor"
+    SEQUENCE = "sequence"
+    EXPERT = "expert"
+    PIPE = "pipe"
+
+    ALL = ("data", "fsdp", "tensor", "sequence", "expert", "pipe")
+
+
+class DefaultValues:
+    MASTER_PORT = 0                 # 0 → pick a free port
+    RDZV_TIMEOUT_S = 600.0
+    RDZV_WAIT_NEW_NODE_S = 30.0     # grace window for extra nodes past min
+    TASK_TIMEOUT_S = 1800.0
+    HEARTBEAT_INTERVAL_S = 15.0
+    HANG_SECONDS = 1800.0
+    MAX_RELAUNCH = 3
+    GRPC_MAX_MESSAGE_MB = 64
+    KV_WAIT_TIMEOUT_S = 300.0
+    MONITOR_INTERVAL_S = 5.0
+    REPORT_RESOURCE_INTERVAL_S = 15.0
+    SPEED_SAMPLE_WINDOW = 20
+    STRAGGLER_MEDIAN_RATIO = 2.0    # t > ratio × median ⇒ straggler
+    SECONDS_PER_SCALE_CHECK = 60.0

@@ -1,0 +1,385 @@
+"""Control-plane message payloads.
+
+Capability parity: dlrover/python/common/grpc.py:118-417 — every master↔agent
+interaction is a typed dataclass carried over a deliberately minimal 2-RPC
+service (`get`, `report`). Unlike the reference's bare pickle, deserialization
+here goes through a restricted unpickler that only admits classes defined in
+this module (plus builtins), so a compromised peer can't instantiate arbitrary
+objects.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class Message:
+    """Base class for all control-plane payloads."""
+
+
+# --------------------------------------------------------------------------
+# Serialization with a class allowlist.
+# --------------------------------------------------------------------------
+
+_SAFE_BUILTINS = {
+    "dict", "list", "tuple", "set", "frozenset", "bytes", "bytearray",
+    "str", "int", "float", "bool", "complex", "NoneType",
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if module == "dlrover_tpu.common.messages":
+            return super().find_class(module, name)
+        if module == "builtins" and name in _SAFE_BUILTINS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"forbidden class in control-plane message: {module}.{name}"
+        )
+
+
+def serialize_message(message: Message) -> bytes:
+    return pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_message(data: bytes) -> Optional[Message]:
+    if not data:
+        return None
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+# --------------------------------------------------------------------------
+# Generic / bookkeeping
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class BaseRequest(Message):
+    node_id: int = -1
+    node_type: str = ""
+
+
+@dataclass
+class Response(Message):
+    success: bool = True
+    reason: str = ""
+
+
+# --------------------------------------------------------------------------
+# Dynamic data sharding (reference: TaskRequest/Task/ShardConfig …)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Shard(Message):
+    start: int = 0
+    end: int = 0
+    indices: Optional[List[int]] = None  # for shuffled text datasets
+    record_offsets: Optional[List[int]] = None
+
+
+@dataclass
+class Task(Message):
+    task_id: int = -1
+    task_type: str = ""          # TaskType.*
+    dataset_name: str = ""
+    shard: Shard = field(default_factory=Shard)
+    epoch: int = 0
+
+    @property
+    def is_empty(self) -> bool:
+        return self.task_id < 0
+
+
+@dataclass
+class TaskRequest(Message):
+    dataset_name: str = ""
+    worker_id: int = -1
+
+
+@dataclass
+class TaskResult(Message):
+    dataset_name: str = ""
+    task_id: int = -1
+    worker_id: int = -1
+    success: bool = True
+    err_message: str = ""
+
+
+@dataclass
+class DatasetShardParams(Message):
+    """Register a dataset for dynamic sharding."""
+
+    dataset_name: str = ""
+    dataset_size: int = 0
+    shard_size: int = 0          # records per shard (batch_size × steps)
+    num_epochs: int = 1
+    shuffle: bool = False
+    task_type: str = ""
+    storage_type: str = "text"   # "table" (range-only) | "text" (indices)
+    num_minibatches_per_shard: int = 0
+
+
+@dataclass
+class ShardCheckpointRequest(Message):
+    dataset_name: str = ""
+
+
+@dataclass
+class ShardCheckpoint(Message):
+    dataset_name: str = ""
+    content: str = ""            # JSON-encoded DatasetShardCheckpoint
+
+
+@dataclass
+class DatasetMeta(Message):
+    dataset_name: str = ""
+
+
+@dataclass
+class DatasetEpochInfo(Message):
+    dataset_name: str = ""
+    epoch: int = 0
+
+
+@dataclass
+class TaskCounts(Message):
+    dataset_name: str = ""
+    todo: int = 0
+    doing: int = 0
+    done: int = 0
+
+
+# --------------------------------------------------------------------------
+# Rendezvous (reference: JoinRendezvousRequest / CommWorldRequest …)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class JoinRendezvousRequest(Message):
+    node_id: int = -1
+    node_rank: int = -1
+    local_world_size: int = 1    # devices (chips) on this host
+    rdzv_name: str = ""
+    node_ip: str = ""
+
+
+@dataclass
+class WaitingNodeNumRequest(Message):
+    node_id: int = -1
+    rdzv_name: str = ""
+
+
+@dataclass
+class WaitingNodeNum(Message):
+    waiting_num: int = 0
+
+
+@dataclass
+class CommWorldRequest(Message):
+    node_id: int = -1
+    rdzv_name: str = ""
+
+
+@dataclass
+class CommWorld(Message):
+    rdzv_name: str = ""
+    round: int = 0
+    group: int = 0
+    world: Dict[int, int] = field(default_factory=dict)  # node_rank → local_world_size
+
+
+@dataclass
+class NetworkStatusReport(Message):
+    node_id: int = -1
+    normal: bool = True
+    elapsed_time: float = 0.0
+
+
+@dataclass
+class NetworkCheckResultRequest(Message):
+    node_id: int = -1
+
+
+@dataclass
+class NetworkCheckVerdict(Message):
+    normal: bool = True
+    is_straggler: bool = False
+    reason: str = ""
+
+
+# --------------------------------------------------------------------------
+# KV store (reference: KeyValuePair)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class KeyValuePair(Message):
+    key: str = ""
+    value: bytes = b""
+
+
+@dataclass
+class KVGetRequest(Message):
+    key: str = ""
+
+
+@dataclass
+class KVAddRequest(Message):
+    key: str = ""
+    amount: int = 0
+
+
+@dataclass
+class KVWaitRequest(Message):
+    """Server-side blocking wait on keys (bounded by the RPC deadline)."""
+
+    keys: List[str] = field(default_factory=list)
+    timeout_s: float = 10.0
+
+
+@dataclass
+class KVIntResult(Message):
+    value: int = 0
+
+
+# --------------------------------------------------------------------------
+# Node health / lifecycle (reference: NodeFailure, GPUStats …)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class NodeFailureReport(Message):
+    node_id: int = -1
+    node_rank: int = -1
+    error_data: str = ""
+    level: str = ""              # TrainingMsgLevel.*
+    restart_count: int = 0
+
+
+@dataclass
+class ChipStats(Message):
+    index: int = 0
+    duty_cycle_pct: float = 0.0
+    hbm_used_mb: float = 0.0
+    hbm_total_mb: float = 0.0
+
+
+@dataclass
+class NodeResourceStats(Message):
+    node_id: int = -1
+    node_type: str = ""
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+    chip_stats: List[ChipStats] = field(default_factory=list)
+
+
+@dataclass
+class NodeHeartbeat(Message):
+    node_id: int = -1
+    timestamp: float = 0.0
+
+
+@dataclass
+class NodeAddressReport(Message):
+    node_id: int = -1
+    node_rank: int = -1
+    addr: str = ""
+
+
+@dataclass
+class GlobalStepReport(Message):
+    node_id: int = -1
+    step: int = 0
+    timestamp: float = 0.0
+
+
+@dataclass
+class ModelInfo(Message):
+    """Static model stats fed to the resource optimizer (reference:
+    common/grpc.py ModelInfo; profile_extractor)."""
+
+    param_count: int = 0
+    param_bytes: int = 0
+    flops_per_step: float = 0.0
+    batch_size: int = 0
+    seq_len: int = 0
+
+
+# --------------------------------------------------------------------------
+# Elastic / scaling control (reference: ParallelConfig, ScalePlan relay)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ParallelConfig(Message):
+    """Master-tuned runtime knobs the worker hot-reloads (reference:
+    paral_config_tuner.py + ElasticDataLoader hot-reload)."""
+
+    dataloader_batch_size: int = 0
+    dataloader_workers: int = 0
+    learning_rate: float = 0.0
+    grad_accum_steps: int = 0
+    version: int = 0
+
+
+@dataclass
+class ParallelConfigRequest(Message):
+    node_id: int = -1
+
+
+@dataclass
+class ScaleRequest(Message):
+    """Manual/auto scale plan relayed to the master (reference: ScalePlan CRD)."""
+
+    node_type: str = ""
+    count: int = 0
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+
+
+@dataclass
+class JobStatusRequest(Message):
+    pass
+
+
+@dataclass
+class JobStatus(Message):
+    stage: str = ""
+    exit_reason: str = ""
+
+
+@dataclass
+class SyncJoinRequest(Message):
+    """Named barrier join (reference: sync_service.py)."""
+
+    sync_name: str = ""
+    node_id: int = -1
+
+
+@dataclass
+class SyncFinishRequest(Message):
+    sync_name: str = ""
+
+
+@dataclass
+class SyncQueryRequest(Message):
+    sync_name: str = ""
+
+
+@dataclass
+class ClusterVersionRequest(Message):
+    """PS-style cluster version arbitration (reference: elastic_ps.py)."""
+
+    task_type: str = ""
+    task_id: int = 0
+    version_type: str = ""       # "local" | "global" | "restored"
+    version: int = 0
+
+
+@dataclass
+class ClusterVersion(Message):
+    version: int = 0

@@ -1,0 +1,70 @@
+"""In-master key/value store backing distributed bootstrap.
+
+Capability parity: dlrover/python/master/elastic_training/kv_store_service.py
+(the store behind the torch ``Store``) — here it bootstraps
+``jax.distributed`` instead: agents publish the coordinator address, barrier
+counters, and per-round process ranks under round-scoped key prefixes, so a
+re-formed world after an elastic resize never collides with stale keys.
+
+Unlike the reference (agents poll `get` in a loop), `wait` blocks server-side
+on a condition variable with a timeout (exposed over RPC as KVWaitRequest),
+so the client needs one RPC per ~20 s window instead of one per poll tick.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class KVStoreService:
+    def __init__(self):
+        self._store: Dict[str, bytes] = {}
+        self._cond = threading.Condition()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._cond:
+            self._store[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str) -> bytes:
+        with self._cond:
+            return self._store.get(key, b"")
+
+    def add(self, key: str, amount: int) -> int:
+        """Atomic integer add; missing key counts as 0."""
+        with self._cond:
+            current = int(self._store.get(key, b"0"))
+            current += amount
+            self._store[key] = str(current).encode()
+            self._cond.notify_all()
+            return current
+
+    def wait(self, keys: List[str], timeout_s: float) -> bool:
+        """Block until every key exists, or timeout. Returns success."""
+        deadline = time.time() + timeout_s
+        with self._cond:
+            while True:
+                if all(k in self._store for k in keys):
+                    return True
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+
+    def delete(self, key: str) -> None:
+        with self._cond:
+            self._store.pop(key, None)
+
+    def clear_prefix(self, prefix: str) -> int:
+        """Drop all keys under a (round-scoped) prefix; returns count."""
+        with self._cond:
+            stale = [k for k in self._store if k.startswith(prefix)]
+            for k in stale:
+                del self._store[k]
+            return len(stale)
+
+    def num_keys(self) -> int:
+        with self._cond:
+            return len(self._store)

@@ -1,0 +1,281 @@
+"""Master-side rendezvous managers.
+
+Capability parity: dlrover/python/master/elastic_training/rdzv_manager.py —
+min/max-node rendezvous with a waiting list and `node_unit` rounding
+(`_check_rdzv_completed` rdzv_manager.py:104, `join_rendezvous` :146), plus
+the 2-round network-check rendezvous with pair grouping, fault isolation and
+2×median straggler verdicts (`_group_nodes` :299, `check_fault_node` :399,
+`_detect_stragglers` :446).
+
+TPU framing: a "node" is one TPU host (one JAX process); ``local_world_size``
+is the host's chip count. A completed rendezvous round yields the world map
+{node_rank → chips} from which agents derive ``jax.distributed`` process
+count/index and the coordinator, then training re-lowers onto the new mesh.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclass
+class RendezvousParameters:
+    min_nodes: int = 1
+    max_nodes: int = 1
+    # After min_nodes have joined, wait this long for late nodes up to max.
+    wait_new_node_s: float = 30.0
+    # World size is rounded down to a multiple of node_unit (e.g. a pipeline
+    # stage count or a DCN slice granule).
+    node_unit: int = 1
+
+
+@dataclass
+class _WaitingNode:
+    node_rank: int
+    local_world_size: int
+    join_time: float = field(default_factory=time.time)
+
+
+class RendezvousManager:
+    """Base rendezvous: collect joiners, cut a round when complete."""
+
+    name = "base"
+
+    def __init__(self, params: Optional[RendezvousParameters] = None):
+        self._params = params or RendezvousParameters()
+        self._lock = threading.Lock()
+        self._waiting: Dict[int, _WaitingNode] = {}
+        self._alive_nodes: set = set()
+        self._rdzv_round = 0
+        self._latest_world: Dict[int, int] = {}   # node_rank -> local_world
+        self._latest_round_start = 0.0
+        self._node_ips: Dict[int, str] = {}
+
+    # -- membership (driven by the node manager / event callbacks) --------
+    def update_rdzv_params(self, min_nodes: int, max_nodes: int,
+                           wait_new_node_s: float = 30.0,
+                           node_unit: int = 1) -> None:
+        with self._lock:
+            self._params = RendezvousParameters(
+                min_nodes, max_nodes, wait_new_node_s, node_unit
+            )
+
+    def add_alive_node(self, node_rank: int) -> None:
+        with self._lock:
+            self._alive_nodes.add(node_rank)
+
+    def remove_alive_node(self, node_rank: int) -> None:
+        with self._lock:
+            self._alive_nodes.discard(node_rank)
+            self._waiting.pop(node_rank, None)
+
+    # -- agent-facing protocol --------------------------------------------
+    def join_rendezvous(self, node_rank: int, local_world_size: int,
+                        node_ip: str = "") -> int:
+        """Register a joiner; returns the round it will be placed in."""
+        with self._lock:
+            self._waiting[node_rank] = _WaitingNode(node_rank,
+                                                    local_world_size)
+            self._alive_nodes.add(node_rank)
+            if node_ip:
+                self._node_ips[node_rank] = node_ip
+            if len(self._waiting) == 1:
+                self._latest_round_start = time.time()
+            return self._rdzv_round
+
+    def get_comm_world(self, node_rank: int
+                       ) -> Tuple[int, int, Dict[int, int]]:
+        """Poll for the completed world. Returns (round, group, world) —
+        empty world while the round is still forming."""
+        with self._lock:
+            if self._check_rdzv_completed():
+                self._cut_round()
+            if node_rank in self._latest_world:
+                return self._rdzv_round - 1, 0, dict(self._latest_world)
+            return self._rdzv_round, 0, {}
+
+    def num_nodes_waiting(self) -> int:
+        """Agents restart workers when >0 while healthy (membership change;
+        reference: training.py:483-486)."""
+        with self._lock:
+            # Before the first round there is no world to change.
+            if not self._latest_world:
+                return 0
+            return len(self._waiting)
+
+    # -- internals ---------------------------------------------------------
+    def _check_rdzv_completed(self) -> bool:
+        """Round completes when every alive node joined, or min_nodes joined
+        and the late-node grace window expired (lock held)."""
+        if not self._waiting:
+            return False
+        num = min(len(self._waiting), self._params.max_nodes)
+        if num < self._params.min_nodes:
+            return False
+        alive_all_joined = (
+            self._alive_nodes
+            and self._alive_nodes.issubset(set(self._waiting))
+        )
+        if num == self._params.max_nodes or alive_all_joined:
+            return self._rounded_size(num) >= self._params.min_nodes
+        waited = time.time() - self._latest_round_start
+        if waited >= self._params.wait_new_node_s:
+            return self._rounded_size(num) >= self._params.min_nodes
+        return False
+
+    def _rounded_size(self, num: int) -> int:
+        unit = max(1, self._params.node_unit)
+        return (num // unit) * unit
+
+    def _cut_round(self) -> None:
+        """Select the world for this round (lock held)."""
+        size = self._rounded_size(
+            min(len(self._waiting), self._params.max_nodes)
+        )
+        # Keep the lowest-ranked `size` nodes; the rest stay waiting for the
+        # next round (node_unit remainder).
+        chosen = sorted(self._waiting)[:size]
+        self._latest_world = {
+            rank: self._waiting[rank].local_world_size for rank in chosen
+        }
+        for rank in chosen:
+            del self._waiting[rank]
+        self._rdzv_round += 1
+        logger.info(
+            "%s rendezvous round %d completed: world=%s",
+            self.name, self._rdzv_round - 1, sorted(self._latest_world),
+        )
+
+    @property
+    def latest_world(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._latest_world)
+
+    @property
+    def rdzv_round(self) -> int:
+        with self._lock:
+            return self._rdzv_round
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    name = "elastic-training"
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """2-round diagnostic rendezvous (reference: rdzv_manager.py:248-461).
+
+    Round 0 groups adjacent pairs; round 1 re-pairs fastest-with-slowest so a
+    node that failed round 0 is re-tested against a known-good partner. On a
+    TPU slice the pair maps to a 2-host sub-mesh probe program (allgather over
+    ICI/DCN); see dlrover_tpu/diagnostics/network_check.py.
+    """
+
+    name = "network-check"
+
+    def __init__(self, params: Optional[RendezvousParameters] = None):
+        super().__init__(params)
+        # round -> {node_rank: (normal, elapsed_time)}
+        self._reports: Dict[int, Dict[int, Tuple[bool, float]]] = {}
+        self._check_round = 0
+        self._groups: Dict[int, List[List[int]]] = {}
+
+    def get_comm_world(self, node_rank: int
+                       ) -> Tuple[int, int, Dict[int, int]]:
+        with self._lock:
+            if self._check_rdzv_completed():
+                self._cut_round()
+                self._groups[self._rdzv_round - 1] = self._group_nodes(
+                    self._check_round
+                )
+                self._check_round += 1
+            round_idx = self._rdzv_round - 1
+            groups = self._groups.get(round_idx, [])
+            for gi, group in enumerate(groups):
+                if node_rank in group:
+                    world = {r: self._latest_world[r] for r in group}
+                    return round_idx, gi, world
+            return self._rdzv_round, 0, {}
+
+    def _group_nodes(self, check_round: int) -> List[List[int]]:
+        """Pair nodes for the probe (lock held). Round 0: adjacent pairs.
+        Round ≥1: sort by last round's elapsed time, pair fastest with
+        slowest (reference: rdzv_manager.py:299-346)."""
+        ranks = sorted(self._latest_world)
+        if check_round == 0 or not self._reports.get(check_round - 1):
+            pairs = [ranks[i:i + 2] for i in range(0, len(ranks), 2)]
+        else:
+            prev = self._reports[check_round - 1]
+            by_time = sorted(
+                ranks, key=lambda r: prev.get(r, (False, float("inf")))[1]
+            )
+            pairs = []
+            lo, hi = 0, len(by_time) - 1
+            while lo < hi:
+                pairs.append([by_time[lo], by_time[hi]])
+                lo += 1
+                hi -= 1
+            if lo == hi:
+                pairs.append([by_time[lo]])
+        # Merge a trailing singleton into the previous pair so it has a peer.
+        if pairs and len(pairs[-1]) == 1 and len(pairs) > 1:
+            pairs[-2].extend(pairs.pop())
+        return pairs
+
+    def report_network_status(self, node_rank: int, normal: bool,
+                              elapsed_time: float) -> None:
+        with self._lock:
+            round_reports = self._reports.setdefault(
+                self._check_round - 1 if self._check_round else 0, {}
+            )
+            round_reports[node_rank] = (normal, elapsed_time)
+
+    def join_rendezvous(self, node_rank: int, local_world_size: int,
+                        node_ip: str = "") -> int:
+        with self._lock:
+            if not self._waiting and self._check_round >= 2:
+                # A full 2-round check cycle was consumed; a new joiner starts
+                # a fresh cycle with a clean slate of verdicts.
+                self._reports.clear()
+                self._groups.clear()
+                self._check_round = 0
+        return super().join_rendezvous(node_rank, local_world_size, node_ip)
+
+    def check_fault_node(self) -> Tuple[List[int], int]:
+        """Nodes abnormal in ALL reported rounds are faulty (reference:
+        check_fault_node rdzv_manager.py:399). Returns (fault_nodes,
+        rounds_reported)."""
+        with self._lock:
+            if not self._reports:
+                return [], 0
+            fault: Optional[set] = None
+            for round_reports in self._reports.values():
+                bad = {r for r, (ok, _) in round_reports.items() if not ok}
+                fault = bad if fault is None else (fault & bad)
+            return sorted(fault or ()), len(self._reports)
+
+    def detect_stragglers(self) -> List[int]:
+        """elapsed > ratio × median in the latest round (reference:
+        _detect_stragglers rdzv_manager.py:446)."""
+        ratio = Context.singleton().straggler_median_ratio
+        with self._lock:
+            if not self._reports:
+                return []
+            latest = self._reports[max(self._reports)]
+            times = [t for ok, t in latest.values() if t > 0]
+            if len(times) < 2:
+                return []
+            median = statistics.median(times)
+            return sorted(
+                r for r, (ok, t) in latest.items() if t > ratio * median
+            )
+
+    def network_check_success(self) -> bool:
+        fault, rounds = self.check_fault_node()
+        return rounds > 0 and not fault

@@ -1,0 +1,227 @@
+"""MasterServicer: dispatch the 2-RPC protocol onto master components.
+
+Capability parity: dlrover/python/master/servicer.py:62-581 — a single
+service with `get(Message)` and `report(Message)`; the servicer dispatches on
+the payload dataclass type. Thin by design: every decision lives in the
+component (rendezvous manager, task manager, KV store, …), the servicer only
+routes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import grpc
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+    RendezvousManager,
+)
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.sync_service import ElasticPsService, SyncService
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        task_manager: Optional[TaskManager] = None,
+        rdzv_managers: Optional[Dict[str, RendezvousManager]] = None,
+        kv_store: Optional[KVStoreService] = None,
+        speed_monitor: Optional[SpeedMonitor] = None,
+        sync_service: Optional[SyncService] = None,
+        elastic_ps_service: Optional[ElasticPsService] = None,
+        job_manager=None,
+    ):
+        self.task_manager = task_manager or TaskManager()
+        self.rdzv_managers: Dict[str, RendezvousManager] = rdzv_managers or {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        self.kv_store = kv_store or KVStoreService()
+        self.speed_monitor = speed_monitor or SpeedMonitor()
+        self.sync_service = sync_service or SyncService()
+        self.elastic_ps_service = elastic_ps_service or ElasticPsService()
+        self.job_manager = job_manager  # optional: node lifecycle owner
+        self._paral_config = msg.ParallelConfig()
+        self._start_time = time.time()
+
+    # ------------------------------------------------------------------
+    # raw byte endpoints (wired into comm.build_server)
+    # ------------------------------------------------------------------
+    def get_bytes(self, payload: bytes,
+                  context: Optional[grpc.ServicerContext] = None) -> bytes:
+        try:
+            request = msg.deserialize_message(payload)
+            response = self.get(request)
+        except Exception:
+            logger.exception("get failed (payload %d bytes)", len(payload))
+            response = msg.Response(success=False, reason="internal error")
+        return msg.serialize_message(response)
+
+    def report_bytes(self, payload: bytes,
+                     context: Optional[grpc.ServicerContext] = None) -> bytes:
+        try:
+            request = msg.deserialize_message(payload)
+            response = self.report(request)
+        except Exception:
+            logger.exception("report failed (payload %d bytes)", len(payload))
+            response = msg.Response(success=False, reason="internal error")
+        return msg.serialize_message(response)
+
+    # ------------------------------------------------------------------
+    # typed dispatch
+    # ------------------------------------------------------------------
+    def get(self, request: msg.Message) -> msg.Message:
+        if isinstance(request, msg.TaskRequest):
+            return self.task_manager.get_dataset_task(
+                request.worker_id, request.dataset_name
+            )
+        if isinstance(request, msg.CommWorldRequest):
+            mgr = self.rdzv_managers[request.rdzv_name]
+            rdzv_round, group, world = mgr.get_comm_world(request.node_id)
+            return msg.CommWorld(rdzv_name=request.rdzv_name,
+                                 round=rdzv_round, group=group, world=world)
+        if isinstance(request, msg.WaitingNodeNumRequest):
+            mgr = self.rdzv_managers[request.rdzv_name]
+            return msg.WaitingNodeNum(waiting_num=mgr.num_nodes_waiting())
+        if isinstance(request, msg.KVGetRequest):
+            return msg.KeyValuePair(key=request.key,
+                                    value=self.kv_store.get(request.key))
+        if isinstance(request, msg.KVWaitRequest):
+            # Cap the blocking window well below typical RPC deadlines so the
+            # client always receives a response, not DEADLINE_EXCEEDED.
+            ok = self.kv_store.wait(request.keys,
+                                    min(request.timeout_s, 20.0))
+            return msg.Response(success=ok)
+        if isinstance(request, msg.NetworkCheckResultRequest):
+            mgr = self.rdzv_managers[RendezvousName.NETWORK_CHECK]
+            fault, rounds = mgr.check_fault_node()
+            stragglers = mgr.detect_stragglers()
+            is_fault = request.node_id in fault
+            is_straggler = request.node_id in stragglers
+            return msg.NetworkCheckVerdict(
+                normal=not is_fault,
+                is_straggler=is_straggler,
+                reason="fault" if is_fault else
+                       ("straggler" if is_straggler else ""),
+            )
+        if isinstance(request, msg.ShardCheckpointRequest):
+            ckpt = self.task_manager.checkpoint_dataset(request.dataset_name)
+            return msg.ShardCheckpoint(
+                dataset_name=request.dataset_name,
+                content=ckpt.to_json() if ckpt else "",
+            )
+        if isinstance(request, msg.DatasetEpochInfo):
+            return msg.DatasetEpochInfo(
+                dataset_name=request.dataset_name,
+                epoch=self.task_manager.get_epoch(request.dataset_name),
+            )
+        if isinstance(request, msg.TaskCounts):
+            todo, doing = self.task_manager.counts(request.dataset_name)
+            return msg.TaskCounts(dataset_name=request.dataset_name,
+                                  todo=todo, doing=doing)
+        if isinstance(request, msg.ParallelConfigRequest):
+            return self._paral_config
+        if isinstance(request, msg.SyncQueryRequest):
+            finished = self.sync_service.sync_finished(request.sync_name)
+            return msg.Response(success=finished)
+        if isinstance(request, msg.ClusterVersionRequest):
+            version = self.elastic_ps_service.get_cluster_version(
+                request.version_type, request.task_type, request.task_id
+            )
+            return msg.ClusterVersion(version=version)
+        if isinstance(request, msg.JobStatusRequest):
+            return self._get_job_status()
+        logger.warning("get: unknown request %s", type(request).__name__)
+        return msg.Response(success=False, reason="unknown request")
+
+    def report(self, request: msg.Message) -> msg.Message:
+        ok = True
+        reason = ""
+        if isinstance(request, msg.DatasetShardParams):
+            self.task_manager.new_dataset(request)
+        elif isinstance(request, msg.TaskResult):
+            ok = self.task_manager.report_dataset_task(
+                request.dataset_name, request.task_id, request.success
+            )
+        elif isinstance(request, msg.JoinRendezvousRequest):
+            mgr = self.rdzv_managers[request.rdzv_name]
+            mgr.join_rendezvous(request.node_rank, request.local_world_size,
+                                request.node_ip)
+        elif isinstance(request, msg.NetworkStatusReport):
+            mgr = self.rdzv_managers[RendezvousName.NETWORK_CHECK]
+            mgr.report_network_status(request.node_id, request.normal,
+                                      request.elapsed_time)
+        elif isinstance(request, msg.KeyValuePair):
+            self.kv_store.set(request.key, request.value)
+        elif isinstance(request, msg.KVAddRequest):
+            value = self.kv_store.add(request.key, request.amount)
+            return msg.KVIntResult(value=value)
+        elif isinstance(request, msg.GlobalStepReport):
+            self.speed_monitor.collect_worker_step(request.node_id,
+                                                   request.step)
+        elif isinstance(request, msg.NodeResourceStats):
+            if self.job_manager is not None:
+                self.job_manager.update_node_resource_usage(request)
+        elif isinstance(request, msg.NodeHeartbeat):
+            if self.job_manager is not None:
+                self.job_manager.collect_heartbeat(request.node_id,
+                                                   request.timestamp)
+        elif isinstance(request, msg.NodeFailureReport):
+            logger.warning("node %d failure (level=%s): %s",
+                           request.node_id, request.level,
+                           request.error_data[:512])
+            if self.job_manager is not None:
+                self.job_manager.handle_failure_report(request)
+            self.task_manager.recover_tasks(request.node_id)
+        elif isinstance(request, msg.NodeAddressReport):
+            self.kv_store.set(f"node-addr/{request.node_rank}",
+                              request.addr.encode())
+        elif isinstance(request, msg.ShardCheckpoint):
+            ok = self.task_manager.restore_dataset_checkpoint(request.content)
+        elif isinstance(request, msg.SyncJoinRequest):
+            ok = self.sync_service.join_sync(request.sync_name,
+                                             request.node_id)
+        elif isinstance(request, msg.SyncFinishRequest):
+            ok = self.sync_service.finish_sync(request.sync_name)
+        elif isinstance(request, msg.ClusterVersionRequest):
+            self.elastic_ps_service.update_cluster_version(
+                request.version_type, request.version,
+                request.task_type, request.task_id,
+            )
+        elif isinstance(request, msg.ParallelConfig):
+            self._paral_config = request
+        elif isinstance(request, msg.ScaleRequest):
+            if self.job_manager is not None:
+                self.job_manager.handle_scale_request(request)
+            else:
+                ok, reason = False, "no job manager"
+        elif isinstance(request, msg.ModelInfo):
+            if self.job_manager is not None:
+                self.job_manager.collect_model_info(request)
+        else:
+            logger.warning("report: unknown request %s",
+                           type(request).__name__)
+            ok, reason = False, "unknown request"
+        return msg.Response(success=ok, reason=reason)
+
+    # ------------------------------------------------------------------
+    def _get_job_status(self) -> msg.JobStatus:
+        from dlrover_tpu.common.constants import JobStage
+
+        if self.job_manager is not None:
+            return msg.JobStatus(stage=self.job_manager.job_stage())
+        stage = (JobStage.SUCCEEDED if self.task_manager.finished()
+                 else JobStage.RUNNING)
+        return msg.JobStatus(stage=stage)
+
+    def update_paral_config(self, config: msg.ParallelConfig) -> None:
+        self._paral_config = config

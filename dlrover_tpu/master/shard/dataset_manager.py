@@ -1,0 +1,201 @@
+"""Per-dataset shard-queue managers with checkpointable data position.
+
+Capability parity: dlrover/python/master/shard/base_dataset_manager.py
+(`DatasetShardCheckpoint` :60) and batch_dataset_manager.py (`get_task` :52,
+`report_task_status` :102, `checkpoint` :157): a todo queue of shard tasks, a
+doing map with start times for timeout recovery, and a JSON checkpoint of
+undone shards so a restarted job resumes at the exact data position.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import Shard, Task
+from dlrover_tpu.master.shard.dataset_splitter import DatasetSplitter
+
+
+@dataclass
+class DoingTask:
+    task: Task
+    worker_id: int
+    start_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class DatasetShardCheckpoint:
+    """JSON-serializable data position (reference: base_dataset_manager.py:60).
+
+    Each todo entry is ``[start, end]`` or ``[start, end, indices]`` — the
+    indices of a shuffled text shard must survive restore or the job would
+    re-read the wrong records.
+    """
+
+    dataset_name: str
+    todo: List[list]
+    epoch: int
+    completed_records: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "dataset_name": self.dataset_name,
+            "todo": self.todo,
+            "epoch": self.epoch,
+            "completed_records": self.completed_records,
+        })
+
+    @classmethod
+    def from_json(cls, content: str) -> "DatasetShardCheckpoint":
+        d = json.loads(content)
+        return cls(
+            dataset_name=d["dataset_name"],
+            todo=[list(t) for t in d["todo"]],
+            epoch=d["epoch"],
+            completed_records=d.get("completed_records", 0),
+        )
+
+
+class BatchDatasetManager:
+    """Dispatch shard tasks of a batch (finite) dataset."""
+
+    def __init__(self, task_type: str, splitter: DatasetSplitter):
+        self._task_type = task_type
+        self._splitter = splitter
+        self.todo: Deque[Task] = deque()
+        self.doing: Dict[int, DoingTask] = {}
+        self._task_id_seq = 0
+        self._completed_records = 0
+        self._max_task_completed_time = 0.0
+
+    @property
+    def dataset_name(self) -> str:
+        return self._splitter.dataset_name
+
+    # -- dispatch ----------------------------------------------------------
+    def get_task(self, worker_id: int) -> Task:
+        """Pop the next todo task; refill from the splitter at epoch края."""
+        if not self.todo and not self._splitter.epoch_finished():
+            self._create_todo_tasks()
+        if not self.todo:
+            if self.doing:
+                # Epoch exhausted but peers still working: tell the worker to
+                # wait — its peers' shards may be requeued on failure.
+                return Task(task_id=-1, task_type=TaskType.WAIT,
+                            dataset_name=self.dataset_name)
+            return Task(task_id=-1, task_type=TaskType.NONE,
+                        dataset_name=self.dataset_name)
+        task = self.todo.popleft()
+        self.doing[task.task_id] = DoingTask(task, worker_id)
+        return task
+
+    def _create_todo_tasks(self) -> None:
+        self._splitter.create_shards()
+        shards = self._splitter.get_shards()
+        epoch = self._splitter.get_epoch()
+        for shard in shards:
+            self.todo.append(Task(
+                task_id=self._task_id_seq,
+                task_type=self._task_type,
+                dataset_name=self.dataset_name,
+                shard=shard,
+                epoch=epoch,
+            ))
+            self._task_id_seq += 1
+        if shards:
+            logger.info("dataset %s: created %d tasks (epoch %d)",
+                        self.dataset_name, len(shards), epoch)
+
+    # -- completion / failure ---------------------------------------------
+    def report_task_status(self, task_id: int, success: bool
+                           ) -> Tuple[bool, Optional[Task]]:
+        """Returns (known, task). Failed tasks are requeued at the front."""
+        doing = self.doing.pop(task_id, None)
+        if doing is None:
+            return False, None
+        if success:
+            elapsed = time.time() - doing.start_time
+            self._max_task_completed_time = max(
+                self._max_task_completed_time, elapsed
+            )
+            shard = doing.task.shard
+            self._completed_records += shard.end - shard.start
+        else:
+            self.todo.appendleft(doing.task)
+        return True, doing.task
+
+    def recover_worker_tasks(self, worker_id: int) -> int:
+        """Requeue every doing task of a dead worker (reference:
+        TaskRescheduleCallback event_callback.py:105)."""
+        stale = [tid for tid, d in self.doing.items()
+                 if d.worker_id == worker_id]
+        for tid in stale:
+            self.todo.appendleft(self.doing.pop(tid).task)
+        return len(stale)
+
+    def recover_timeout_tasks(self, timeout_s: float) -> int:
+        now = time.time()
+        stale = [tid for tid, d in self.doing.items()
+                 if now - d.start_time > timeout_s]
+        for tid in stale:
+            doing = self.doing.pop(tid)
+            logger.warning("task %d of worker %d timed out; requeueing",
+                           tid, doing.worker_id)
+            self.todo.appendleft(doing.task)
+        return len(stale)
+
+    def completed(self) -> bool:
+        return (self._splitter.epoch_finished() and not self.todo
+                and not self.doing)
+
+    @property
+    def completed_records(self) -> int:
+        return self._completed_records
+
+    def counts(self) -> Tuple[int, int]:
+        return len(self.todo), len(self.doing)
+
+    def get_epoch(self) -> int:
+        return self._splitter.get_epoch()
+
+    # -- data-position checkpoint -----------------------------------------
+    def checkpoint(self) -> DatasetShardCheckpoint:
+        """Snapshot undone shards: todo + doing (doing counts as undone —
+        the worker may die before completing it)."""
+        def entry(shard: Shard) -> list:
+            if shard.indices is not None:
+                return [shard.start, shard.end, shard.indices]
+            return [shard.start, shard.end]
+
+        todo = [entry(t.shard) for t in self.todo]
+        todo += [entry(d.task.shard) for d in self.doing.values()]
+        return DatasetShardCheckpoint(
+            dataset_name=self.dataset_name,
+            todo=todo,
+            epoch=self._splitter.get_epoch(),
+            completed_records=self._completed_records,
+        )
+
+    def restore_checkpoint(self, ckpt: DatasetShardCheckpoint) -> None:
+        """Rebuild the todo queue from a checkpoint, discarding in-memory
+        state (reference: batch_dataset_manager.py restore path)."""
+        self.todo.clear()
+        self.doing.clear()
+        self._splitter.epoch = ckpt.epoch
+        self._completed_records = ckpt.completed_records
+        for item in ckpt.todo:
+            start, end = item[0], item[1]
+            indices = item[2] if len(item) > 2 else None
+            self.todo.append(Task(
+                task_id=self._task_id_seq,
+                task_type=self._task_type,
+                dataset_name=self.dataset_name,
+                shard=Shard(start=start, end=end, indices=indices),
+                epoch=ckpt.epoch,
+            ))
+            self._task_id_seq += 1

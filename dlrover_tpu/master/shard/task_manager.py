@@ -1,0 +1,136 @@
+"""TaskManager: dynamic data sharding front door on the master.
+
+Capability parity: dlrover/python/master/shard/task_manager.py:37 — owns one
+dataset manager per registered dataset, dispatches shard tasks to whichever
+worker asks (faster workers naturally get more data), recovers tasks of dead
+workers and timed-out tasks, and exposes the data-position checkpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import DatasetShardParams, Task
+from dlrover_tpu.master.shard.dataset_manager import (
+    BatchDatasetManager,
+    DatasetShardCheckpoint,
+)
+from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
+
+
+class TaskManager:
+    def __init__(self, worker_restart_timeout_s: float = 0.0):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, BatchDatasetManager] = {}
+        self._worker_last_task_time: Dict[int, float] = {}
+        self._worker_restart_timeout_s = worker_restart_timeout_s
+        self.speed_monitor = None   # wired by the job master
+
+    # -- dataset registration ---------------------------------------------
+    def new_dataset(self, params: DatasetShardParams) -> None:
+        with self._lock:
+            if params.dataset_name in self._datasets:
+                return  # idempotent: restarted workers re-register
+            splitter = new_dataset_splitter(
+                params.storage_type,
+                params.dataset_name,
+                params.dataset_size,
+                params.shard_size,
+                params.num_epochs,
+                params.shuffle,
+            )
+            self._datasets[params.dataset_name] = BatchDatasetManager(
+                params.task_type, splitter
+            )
+            logger.info("registered dataset %s: size=%d shard=%d epochs=%d",
+                        params.dataset_name, params.dataset_size,
+                        params.shard_size, params.num_epochs)
+
+    def get_dataset(self, name: str) -> Optional[BatchDatasetManager]:
+        with self._lock:
+            return self._datasets.get(name)
+
+    # -- dispatch ----------------------------------------------------------
+    def get_dataset_task(self, worker_id: int, dataset_name: str) -> Task:
+        with self._lock:
+            dataset = self._datasets.get(dataset_name)
+            if dataset is None:
+                return Task(task_id=-1, dataset_name=dataset_name)
+            self._worker_last_task_time[worker_id] = time.time()
+            return dataset.get_task(worker_id)
+
+    def report_dataset_task(self, dataset_name: str, task_id: int,
+                            success: bool) -> bool:
+        with self._lock:
+            dataset = self._datasets.get(dataset_name)
+            if dataset is None:
+                return False
+            known, _task = dataset.report_task_status(task_id, success)
+            return known
+
+    # -- recovery ----------------------------------------------------------
+    def recover_tasks(self, worker_id: int) -> None:
+        """A worker died: requeue all its doing tasks (reference:
+        task_manager.py recover_tasks + TaskRescheduleCallback)."""
+        with self._lock:
+            for dataset in self._datasets.values():
+                n = dataset.recover_worker_tasks(worker_id)
+                if n:
+                    logger.info("requeued %d tasks of dead worker %d (%s)",
+                                n, worker_id, dataset.dataset_name)
+
+    def recover_timeout_tasks(self) -> None:
+        timeout = Context.singleton().task_timeout_s
+        with self._lock:
+            for dataset in self._datasets.values():
+                dataset.recover_timeout_tasks(timeout)
+
+    def start_timeout_recovery(self, interval_s: float = 60.0
+                               ) -> threading.Thread:
+        def loop():
+            while True:
+                time.sleep(interval_s)
+                self.recover_timeout_tasks()
+
+        thread = threading.Thread(target=loop, daemon=True,
+                                  name="task-timeout-recovery")
+        thread.start()
+        return thread
+
+    # -- status ------------------------------------------------------------
+    def finished(self) -> bool:
+        """All registered datasets exhausted (and at least one exists)."""
+        with self._lock:
+            return bool(self._datasets) and all(
+                d.completed() for d in self._datasets.values()
+            )
+
+    def counts(self, dataset_name: str) -> Tuple[int, int]:
+        with self._lock:
+            dataset = self._datasets.get(dataset_name)
+            return dataset.counts() if dataset else (0, 0)
+
+    def get_epoch(self, dataset_name: str) -> int:
+        with self._lock:
+            dataset = self._datasets.get(dataset_name)
+            return dataset.get_epoch() if dataset else 0
+
+    # -- data-position checkpoint -----------------------------------------
+    def checkpoint_dataset(self, dataset_name: str
+                           ) -> Optional[DatasetShardCheckpoint]:
+        with self._lock:
+            dataset = self._datasets.get(dataset_name)
+            return dataset.checkpoint() if dataset else None
+
+    def restore_dataset_checkpoint(self, content: str) -> bool:
+        ckpt = DatasetShardCheckpoint.from_json(content)
+        with self._lock:
+            dataset = self._datasets.get(ckpt.dataset_name)
+            if dataset is None:
+                return False
+            dataset.restore_checkpoint(ckpt)
+            return True

@@ -1,0 +1,97 @@
+"""Global-step speed monitoring and hang detection.
+
+Capability parity: dlrover/python/master/monitor/speed_monitor.py:43 —
+collect (timestamp, global_step) samples, compute windowed throughput,
+track per-worker step reports, and flag a hang when no step progress is made
+for `hang_seconds`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Set, Tuple
+
+from dlrover_tpu.common.config import Context
+
+
+class SpeedMonitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        ctx = Context.singleton()
+        self._samples: Deque[Tuple[float, int]] = deque(
+            maxlen=ctx.speed_sample_window
+        )
+        self._global_step = 0
+        self._first_step_time: Optional[float] = None
+        self._last_step_time: float = time.time()
+        self._workers: Set[int] = set()
+        self._worker_steps: Dict[int, int] = {}
+        self._start_training_time: Optional[float] = None
+        self._paused_time_s: float = 0.0
+
+    # -- sample collection -------------------------------------------------
+    def collect_global_step(self, step: int,
+                            timestamp: Optional[float] = None) -> None:
+        timestamp = timestamp or time.time()
+        with self._lock:
+            if step <= self._global_step:
+                return
+            if self._first_step_time is None:
+                self._first_step_time = timestamp
+            self._global_step = step
+            self._last_step_time = timestamp
+            self._samples.append((timestamp, step))
+
+    def collect_worker_step(self, worker_id: int, step: int) -> None:
+        with self._lock:
+            self._worker_steps[worker_id] = step
+        self.collect_global_step(step)
+
+    def set_start_training(self) -> None:
+        with self._lock:
+            if self._start_training_time is None:
+                self._start_training_time = time.time()
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def completed_global_step(self) -> int:
+        with self._lock:
+            return self._global_step
+
+    def running_speed(self) -> float:
+        """Steps/second over the sample window."""
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            (t0, s0), (t1, s1) = self._samples[0], self._samples[-1]
+            if t1 <= t0:
+                return 0.0
+            return (s1 - s0) / (t1 - t0)
+
+    def all_worker_joined(self, expected: int) -> bool:
+        with self._lock:
+            return len(self._workers) >= expected
+
+    def add_running_worker(self, worker_id: int) -> None:
+        with self._lock:
+            self._workers.add(worker_id)
+
+    def remove_running_worker(self, worker_id: int) -> None:
+        with self._lock:
+            self._workers.discard(worker_id)
+            self._worker_steps.pop(worker_id, None)
+
+    def is_hanged(self, hang_seconds: Optional[float] = None) -> bool:
+        """No step progress for hang_seconds while training had started."""
+        hang_seconds = hang_seconds or Context.singleton().hang_seconds
+        with self._lock:
+            if self._first_step_time is None:
+                return False
+            return (time.time() - self._last_step_time) > hang_seconds
+
+    def reset_running_speed(self) -> None:
+        """Call at membership change: old samples reflect the old world."""
+        with self._lock:
+            self._samples.clear()

@@ -1,0 +1,45 @@
+"""Test fixtures: force an 8-device virtual CPU platform before JAX init.
+
+Mirrors the reference test strategy (SURVEY.md §4): no cluster, no real
+accelerator — master logic tested in-memory, multi-device logic on a virtual
+CPU mesh via ``xla_force_host_platform_device_count``.
+"""
+
+import os
+import sys
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+_existing = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _existing:
+    os.environ["XLA_FLAGS"] = (_existing + " " + _FLAG).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# jax may already be imported by a pytest plugin; XLA_FLAGS is only read at
+# backend init, which must not have happened yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices("cpu")) >= 8, (
+    "XLA backend initialized before conftest could set "
+    "xla_force_host_platform_device_count; run pytest from the repo root"
+)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {len(devices)}"
+    return devices[:8]
+
+
+@pytest.fixture()
+def free_port():
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port

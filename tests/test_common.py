@@ -1,0 +1,144 @@
+"""Tests for the common layer: node state machine, message serialization,
+global context (reference analogues: test_node.py / grpc message tests)."""
+
+import os
+import pickle
+
+import pytest
+
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.messages import (
+    CommWorld,
+    JoinRendezvousRequest,
+    Task,
+    deserialize_message,
+    serialize_message,
+)
+from dlrover_tpu.common.node import (
+    Node,
+    NodeResource,
+    get_node_state_flow,
+)
+
+
+class TestNodeStateFlow:
+    def test_pending_to_running(self):
+        flow = get_node_state_flow(
+            NodeStatus.PENDING, NodeEventType.MODIFIED, NodeStatus.RUNNING
+        )
+        assert flow is not None and not flow.should_relaunch
+
+    def test_running_failure_relaunches(self):
+        flow = get_node_state_flow(
+            NodeStatus.RUNNING, NodeEventType.MODIFIED, NodeStatus.FAILED
+        )
+        assert flow is not None and flow.should_relaunch
+
+    def test_same_status_is_noop(self):
+        assert (
+            get_node_state_flow(
+                NodeStatus.RUNNING, NodeEventType.MODIFIED, NodeStatus.RUNNING
+            )
+            is None
+        )
+
+    def test_delete_after_success_no_relaunch(self):
+        flow = get_node_state_flow(
+            NodeStatus.SUCCEEDED, NodeEventType.DELETED, NodeStatus.DELETED
+        )
+        assert flow is not None and not flow.should_relaunch
+
+    def test_delete_while_running_relaunches(self):
+        flow = get_node_state_flow(
+            NodeStatus.RUNNING, NodeEventType.DELETED, NodeStatus.DELETED
+        )
+        assert flow is not None and flow.should_relaunch
+
+
+class TestNode:
+    def test_relaunch_inherits_rank_and_counts(self):
+        node = Node(NodeType.WORKER, 3, rank_index=1,
+                    config_resource=NodeResource(cpu=4, chips=4))
+        node.exit_reason = NodeExitReason.KILLED
+        new = node.get_relaunch_node(new_id=7)
+        assert new.rank_index == 1
+        assert new.relaunch_count == 1
+        assert new.config_resource.chips == 4
+
+    def test_unrecoverable_on_fatal_or_budget(self):
+        node = Node(NodeType.WORKER, 0, max_relaunch_count=2)
+        assert not node.is_unrecoverable_failure()
+        node.exit_reason = NodeExitReason.FATAL_ERROR
+        assert node.is_unrecoverable_failure()
+        node2 = Node(NodeType.WORKER, 1, max_relaunch_count=2)
+        node2.relaunch_count = 2
+        assert node2.is_unrecoverable_failure()
+
+    def test_update_status_records_times(self):
+        node = Node(NodeType.WORKER, 0)
+        node.update_status(NodeStatus.RUNNING)
+        assert node.start_time is not None
+        node.update_status(NodeStatus.SUCCEEDED)
+        assert node.finish_time is not None
+
+
+class TestMessages:
+    def test_roundtrip(self):
+        msg = JoinRendezvousRequest(node_id=2, node_rank=2,
+                                    local_world_size=4,
+                                    rdzv_name="elastic-training")
+        out = deserialize_message(serialize_message(msg))
+        assert out == msg
+
+    def test_nested_dataclass_roundtrip(self):
+        world = CommWorld(rdzv_name="x", round=3, world={0: 4, 1: 4})
+        assert deserialize_message(serialize_message(world)) == world
+
+    def test_forbidden_class_rejected(self):
+        payload = pickle.dumps(os.system)
+        with pytest.raises(Exception):
+            deserialize_message(payload)
+
+    def test_empty_task(self):
+        assert Task().is_empty
+        assert not Task(task_id=0).is_empty
+
+
+class TestContext:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_MAX_RELAUNCH", "9")
+        Context.reset()
+        try:
+            assert Context.singleton().max_relaunch == 9
+        finally:
+            Context.reset()
+
+    def test_update(self):
+        Context.reset()
+        ctx = Context.singleton()
+        ctx.update(hang_seconds=123.0, nonexistent_key=1)
+        assert ctx.hang_seconds == 123.0
+        assert not hasattr(ctx, "nonexistent_key")
+        Context.reset()
+
+
+class TestMessageSecurity:
+    def test_builtins_callables_rejected(self):
+        """builtins.eval / os.system via __reduce__ must not deserialize."""
+        payload = pickle.dumps(eval)
+        with pytest.raises(Exception):
+            deserialize_message(payload)
+
+    def test_reduce_gadget_rejected(self):
+        class Gadget:
+            def __reduce__(self):
+                return (eval, ("1+1",))
+
+        with pytest.raises(Exception):
+            deserialize_message(pickle.dumps(Gadget()))

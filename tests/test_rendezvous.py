@@ -1,0 +1,179 @@
+"""In-memory rendezvous tests (reference analogue:
+dlrover/python/tests/test_rdzv_manager.py)."""
+
+import time
+
+from dlrover_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+    RendezvousParameters,
+)
+
+
+def make_mgr(min_nodes, max_nodes, wait=0.0, unit=1):
+    return ElasticTrainingRendezvousManager(
+        RendezvousParameters(min_nodes, max_nodes, wait, unit)
+    )
+
+
+class TestElasticTrainingRendezvous:
+    def test_round_completes_when_all_join(self):
+        mgr = make_mgr(2, 4, wait=3600.0)
+        mgr.join_rendezvous(0, 4)
+        _, _, world = mgr.get_comm_world(0)
+        assert world == {}  # node 1 is alive? no — only node 0 alive, joined
+        mgr.join_rendezvous(1, 4)
+        rnd, group, world = mgr.get_comm_world(0)
+        assert world == {0: 4, 1: 4}
+        assert rnd == 0 and group == 0
+
+    def test_single_node_world(self):
+        mgr = make_mgr(1, 1)
+        mgr.join_rendezvous(0, 8)
+        _, _, world = mgr.get_comm_world(0)
+        assert world == {0: 8}
+
+    def test_waits_for_alive_nodes(self):
+        """If 3 nodes are alive but only 2 joined, and the grace window has
+        not expired, the round must not cut."""
+        mgr = make_mgr(2, 3, wait=3600.0)
+        mgr.add_alive_node(0)
+        mgr.add_alive_node(1)
+        mgr.add_alive_node(2)
+        mgr.join_rendezvous(0, 4)
+        mgr.join_rendezvous(1, 4)
+        _, _, world = mgr.get_comm_world(0)
+        assert world == {}
+        mgr.join_rendezvous(2, 4)
+        _, _, world = mgr.get_comm_world(0)
+        assert set(world) == {0, 1, 2}
+
+    def test_grace_window_cut_without_stragglers(self):
+        mgr = make_mgr(2, 4, wait=0.05)
+        mgr.add_alive_node(9)  # alive but never joins
+        mgr.join_rendezvous(0, 4)
+        mgr.join_rendezvous(1, 4)
+        _, _, world = mgr.get_comm_world(0)
+        assert world == {}
+        time.sleep(0.06)
+        _, _, world = mgr.get_comm_world(0)
+        assert set(world) == {0, 1}
+
+    def test_node_unit_rounding(self):
+        """5 joiners with node_unit=2 → world of 4; 1 left waiting."""
+        mgr = make_mgr(2, 8, wait=0.0, unit=2)
+        for rank in range(5):
+            mgr.join_rendezvous(rank, 4)
+        _, _, world = mgr.get_comm_world(0)
+        assert len(world) == 4
+        assert mgr.num_nodes_waiting() == 1
+
+    def test_dead_node_removed_before_round(self):
+        mgr = make_mgr(2, 4, wait=3600.0)
+        for rank in range(3):
+            mgr.join_rendezvous(rank, 4)
+        mgr.remove_alive_node(2)
+        _, _, world = mgr.get_comm_world(0)
+        assert set(world) == {0, 1}
+
+    def test_membership_change_signal(self):
+        mgr = make_mgr(1, 4, wait=0.0)
+        mgr.join_rendezvous(0, 4)
+        mgr.get_comm_world(0)
+        assert mgr.num_nodes_waiting() == 0
+        mgr.join_rendezvous(1, 4)  # a new node appears
+        assert mgr.num_nodes_waiting() > 0
+
+    def test_next_round_after_restart(self):
+        mgr = make_mgr(2, 2, wait=3600.0)
+        mgr.join_rendezvous(0, 4)
+        mgr.join_rendezvous(1, 4)
+        rnd0, _, world0 = mgr.get_comm_world(0)
+        assert world0 and rnd0 == 0
+        # both re-join (worker restart)
+        mgr.join_rendezvous(0, 4)
+        mgr.join_rendezvous(1, 4)
+        rnd1, _, world1 = mgr.get_comm_world(1)
+        assert world1 == {0: 4, 1: 4}
+        assert rnd1 == 1
+
+
+class TestNetworkCheckRendezvous:
+    def _join_all(self, mgr, n):
+        for rank in range(n):
+            mgr.join_rendezvous(rank, 4)
+
+    def test_round0_adjacent_pairs(self):
+        mgr = NetworkCheckRendezvousManager(
+            RendezvousParameters(4, 4, 0.0)
+        )
+        self._join_all(mgr, 4)
+        _, g0, w0 = mgr.get_comm_world(0)
+        _, g2, w2 = mgr.get_comm_world(2)
+        assert set(w0) == {0, 1} and set(w2) == {2, 3}
+        assert g0 != g2
+
+    def test_round1_pairs_fast_with_slow(self):
+        mgr = NetworkCheckRendezvousManager(
+            RendezvousParameters(4, 4, 0.0)
+        )
+        self._join_all(mgr, 4)
+        for rank in range(4):
+            mgr.get_comm_world(rank)
+        # report round-0 results: node 3 very slow
+        times = {0: 1.0, 1: 1.1, 2: 1.2, 3: 50.0}
+        for rank, t in times.items():
+            mgr.report_network_status(rank, True, t)
+        self._join_all(mgr, 4)
+        _, _, world_fast = mgr.get_comm_world(0)
+        # fastest (0) paired with slowest (3)
+        assert set(world_fast) == {0, 3}
+
+    def test_fault_node_must_fail_both_rounds(self):
+        mgr = NetworkCheckRendezvousManager(
+            RendezvousParameters(2, 2, 0.0)
+        )
+        self._join_all(mgr, 2)
+        mgr.get_comm_world(0)
+        mgr.report_network_status(0, False, 0.0)
+        mgr.report_network_status(1, True, 1.0)
+        fault, rounds = mgr.check_fault_node()
+        assert fault == [0] and rounds == 1
+        # round 2: node 0 now passes → not faulty
+        self._join_all(mgr, 2)
+        mgr.get_comm_world(0)
+        mgr.report_network_status(0, True, 1.0)
+        mgr.report_network_status(1, True, 1.0)
+        fault, rounds = mgr.check_fault_node()
+        assert fault == [] and rounds == 2
+        assert mgr.network_check_success()
+
+    def test_straggler_two_x_median(self):
+        mgr = NetworkCheckRendezvousManager(
+            RendezvousParameters(4, 4, 0.0)
+        )
+        self._join_all(mgr, 4)
+        mgr.get_comm_world(0)
+        for rank, t in {0: 20.0, 1: 21.0, 2: 20.5, 3: 150.0}.items():
+            mgr.report_network_status(rank, True, t)
+        assert mgr.detect_stragglers() == [3]
+
+    def test_odd_node_count_merges_singleton(self):
+        mgr = NetworkCheckRendezvousManager(
+            RendezvousParameters(3, 3, 0.0)
+        )
+        self._join_all(mgr, 3)
+        worlds = [set(mgr.get_comm_world(r)[2]) for r in range(3)]
+        # everyone belongs to a group of >= 2
+        assert all(len(w) >= 2 for w in worlds)
+
+
+class TestRendezvousOverflow:
+    def test_more_joiners_than_max_still_cuts(self):
+        """len(waiting) > max_nodes must cut a max_nodes round, not deadlock."""
+        mgr = make_mgr(2, 2, wait=3600.0)
+        for rank in range(3):
+            mgr.join_rendezvous(rank, 4)
+        _, _, world = mgr.get_comm_world(0)
+        assert len(world) == 2
+        assert mgr.num_nodes_waiting() == 1

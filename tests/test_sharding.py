@@ -1,0 +1,249 @@
+"""Dynamic-sharding tests (reference analogues: test_dataset_splitter.py,
+test_task_manager.py, batch_dataset_manager tests)."""
+
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.messages import DatasetShardParams
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.shard.dataset_manager import (
+    BatchDatasetManager,
+    DatasetShardCheckpoint,
+)
+from dlrover_tpu.master.shard.dataset_splitter import (
+    TableDatasetSplitter,
+    TextDatasetSplitter,
+    new_dataset_splitter,
+)
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+
+class TestDatasetSplitter:
+    def test_table_splitter_ranges(self):
+        splitter = TableDatasetSplitter("d", 100, 30)
+        splitter.create_shards()
+        shards = splitter.get_shards()
+        assert [(s.start, s.end) for s in shards] == [
+            (0, 30), (30, 60), (60, 90), (90, 100)
+        ]
+        assert splitter.epoch_finished()
+
+    def test_text_splitter_indices_cover_dataset(self):
+        splitter = TextDatasetSplitter("d", 10, 4, shuffle=True, seed=0)
+        splitter.create_shards()
+        shards = splitter.get_shards()
+        all_indices = [i for s in shards for i in s.indices]
+        assert sorted(all_indices) == list(range(10))
+
+    def test_huge_dataset_sub_epochs(self):
+        splitter = TableDatasetSplitter(
+            "d", dataset_size=100, shard_size=1, num_epochs=1,
+            max_shard_count=10,
+        )
+        seen = []
+        while not splitter.epoch_finished():
+            splitter.create_shards()
+            seen.extend((s.start, s.end) for s in splitter.get_shards())
+        assert len(seen) == 100
+        assert sorted(seen) == [(i, i + 1) for i in range(100)]
+
+    def test_factory(self):
+        assert isinstance(new_dataset_splitter("table", "d", 10, 2),
+                          TableDatasetSplitter)
+        assert isinstance(new_dataset_splitter("text", "d", 10, 2),
+                          TextDatasetSplitter)
+
+
+def make_manager(size=100, shard=10, epochs=1):
+    splitter = TableDatasetSplitter("ds", size, shard, epochs)
+    return BatchDatasetManager(TaskType.TRAINING, splitter)
+
+
+class TestBatchDatasetManager:
+    def test_dispatch_and_complete(self):
+        mgr = make_manager(size=20, shard=10)
+        t0 = mgr.get_task(worker_id=0)
+        t1 = mgr.get_task(worker_id=1)
+        assert not t0.is_empty and not t1.is_empty
+        assert mgr.counts() == (0, 2)
+        mgr.report_task_status(t0.task_id, True)
+        mgr.report_task_status(t1.task_id, True)
+        assert mgr.completed()
+        assert mgr.completed_records == 20
+
+    def test_wait_task_while_peers_working(self):
+        mgr = make_manager(size=10, shard=10)
+        t0 = mgr.get_task(worker_id=0)
+        t_wait = mgr.get_task(worker_id=1)
+        assert t_wait.task_type == TaskType.WAIT
+        mgr.report_task_status(t0.task_id, True)
+        t_none = mgr.get_task(worker_id=1)
+        assert t_none.task_type == TaskType.NONE
+
+    def test_failed_task_requeued(self):
+        mgr = make_manager(size=10, shard=10)
+        t0 = mgr.get_task(worker_id=0)
+        mgr.report_task_status(t0.task_id, False)
+        t1 = mgr.get_task(worker_id=1)
+        assert (t1.shard.start, t1.shard.end) == (t0.shard.start, t0.shard.end)
+
+    def test_dead_worker_tasks_recovered(self):
+        mgr = make_manager(size=30, shard=10)
+        mgr.get_task(worker_id=0)
+        mgr.get_task(worker_id=0)
+        mgr.get_task(worker_id=1)
+        assert mgr.recover_worker_tasks(0) == 2
+        assert mgr.counts() == (2, 1)
+
+    def test_timeout_recovery(self):
+        mgr = make_manager(size=10, shard=10)
+        mgr.get_task(worker_id=0)
+        assert mgr.recover_timeout_tasks(timeout_s=0.0) == 1
+        assert mgr.counts() == (1, 0)
+
+    def test_checkpoint_restore_roundtrip(self):
+        mgr = make_manager(size=40, shard=10)
+        t0 = mgr.get_task(worker_id=0)   # doing
+        mgr.get_task(worker_id=1)        # doing
+        mgr.report_task_status(t0.task_id, True)
+        ckpt = mgr.checkpoint()
+        # 2 still in todo + 1 doing = 3 undone shards
+        assert len(ckpt.todo) == 3
+        assert ckpt.completed_records == 10
+        restored = make_manager(size=40, shard=10)
+        restored.restore_checkpoint(
+            DatasetShardCheckpoint.from_json(ckpt.to_json())
+        )
+        starts = set()
+        while True:
+            t = restored.get_task(0)
+            if t.is_empty:
+                break
+            starts.add(t.shard.start)
+            restored.report_task_status(t.task_id, True)
+        assert len(starts) == 3 and t0.shard.start not in starts
+        assert restored.completed()
+
+
+class TestTaskManager:
+    def _params(self, name="ds", size=20, shard=10):
+        return DatasetShardParams(
+            dataset_name=name, dataset_size=size, shard_size=shard,
+            num_epochs=1, task_type=TaskType.TRAINING, storage_type="table",
+        )
+
+    def test_register_idempotent(self):
+        tm = TaskManager()
+        tm.new_dataset(self._params())
+        t = tm.get_dataset_task(0, "ds")
+        tm.new_dataset(self._params())  # re-register must not reset
+        assert tm.counts("ds") == (1, 1)
+        assert not t.is_empty
+
+    def test_worker_failure_requeues(self):
+        tm = TaskManager()
+        tm.new_dataset(self._params())
+        tm.get_dataset_task(0, "ds")
+        tm.recover_tasks(0)
+        assert tm.counts("ds") == (2, 0)
+
+    def test_finished(self):
+        tm = TaskManager()
+        assert not tm.finished()
+        tm.new_dataset(self._params(size=10, shard=10))
+        t = tm.get_dataset_task(0, "ds")
+        tm.report_dataset_task("ds", t.task_id, True)
+        assert tm.finished()
+
+    def test_checkpoint_via_manager(self):
+        tm = TaskManager()
+        tm.new_dataset(self._params(size=30, shard=10))
+        tm.get_dataset_task(0, "ds")
+        ckpt = tm.checkpoint_dataset("ds")
+        assert len(ckpt.todo) == 3
+        assert tm.restore_dataset_checkpoint(ckpt.to_json())
+
+
+class TestKVStore:
+    def test_set_get_delete(self):
+        kv = KVStoreService()
+        kv.set("a", b"1")
+        assert kv.get("a") == b"1"
+        kv.delete("a")
+        assert kv.get("a") == b""
+
+    def test_add(self):
+        kv = KVStoreService()
+        assert kv.add("counter", 2) == 2
+        assert kv.add("counter", 3) == 5
+
+    def test_wait_blocks_until_set(self):
+        import threading
+
+        kv = KVStoreService()
+
+        def setter():
+            kv.set("k", b"v")
+
+        threading.Timer(0.05, setter).start()
+        assert kv.wait(["k"], timeout_s=2.0)
+
+    def test_wait_timeout(self):
+        kv = KVStoreService()
+        assert not kv.wait(["missing"], timeout_s=0.05)
+
+    def test_clear_prefix(self):
+        kv = KVStoreService()
+        kv.set("round0/a", b"x")
+        kv.set("round0/b", b"y")
+        kv.set("round1/a", b"z")
+        assert kv.clear_prefix("round0/") == 2
+        assert kv.get("round1/a") == b"z"
+
+
+class TestSpeedMonitor:
+    def test_speed_from_samples(self):
+        sm = SpeedMonitor()
+        sm.collect_global_step(10, timestamp=100.0)
+        sm.collect_global_step(20, timestamp=105.0)
+        assert abs(sm.running_speed() - 2.0) < 1e-6
+
+    def test_stale_steps_ignored(self):
+        sm = SpeedMonitor()
+        sm.collect_global_step(10, timestamp=100.0)
+        sm.collect_global_step(5, timestamp=105.0)
+        assert sm.completed_global_step == 10
+
+    def test_hang_detection(self):
+        sm = SpeedMonitor()
+        assert not sm.is_hanged(hang_seconds=0.0)  # no steps yet
+        sm.collect_global_step(1)
+        assert not sm.is_hanged(hang_seconds=60.0)
+        import time
+
+        time.sleep(0.01)
+        assert sm.is_hanged(hang_seconds=0.005)
+
+
+class TestTextShardCheckpoint:
+    def test_shuffled_indices_survive_restore(self):
+        from dlrover_tpu.master.shard.dataset_splitter import (
+            TextDatasetSplitter,
+        )
+        from dlrover_tpu.master.shard.dataset_manager import (
+            BatchDatasetManager,
+        )
+
+        splitter = TextDatasetSplitter("t", 8, 4, shuffle=True, seed=7)
+        mgr = BatchDatasetManager(TaskType.TRAINING, splitter)
+        t = mgr.get_task(0)
+        original_indices = list(t.shard.indices)
+        ckpt = mgr.checkpoint()
+        restored = BatchDatasetManager(
+            TaskType.TRAINING, TextDatasetSplitter("t", 8, 4, shuffle=True)
+        )
+        restored.restore_checkpoint(
+            DatasetShardCheckpoint.from_json(ckpt.to_json())
+        )
+        got = {tuple(task.shard.indices or ())
+               for task in list(restored.todo)}
+        assert tuple(original_indices) in got
