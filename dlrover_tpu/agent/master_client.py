@@ -29,7 +29,8 @@ def retry_rpc(retries: int = 10, backoff_s: float = 1.0):
                     return fn(*args, **kwargs)
                 except Exception as exc:  # noqa: BLE001 — grpc errors vary
                     last_exc = exc
-                    time.sleep(backoff_s * min(attempt + 1, 5))
+                    if attempt < retries - 1:
+                        time.sleep(backoff_s * min(attempt + 1, 5))
             raise last_exc
 
         return wrapped
@@ -165,7 +166,13 @@ class MasterClient:
                                msg.KeyValuePair).value
 
     def kv_add(self, key: str, amount: int) -> int:
-        return self._report(msg.KVAddRequest(key=key, amount=amount)).value
+        result = self._report(msg.KVAddRequest(key=key, amount=amount))
+        if not isinstance(result, msg.KVIntResult):
+            raise RuntimeError(
+                f"master error for KVAddRequest: "
+                f"{getattr(result, 'reason', repr(result))}"
+            )
+        return result.value
 
     def kv_wait(self, key: str, timeout_s: float = 300.0) -> bytes:
         """Block until the key appears: the master holds each RPC open on a

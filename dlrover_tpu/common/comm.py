@@ -117,11 +117,18 @@ def build_server(
 
 
 def local_ip() -> str:
-    """Best-effort routable address of this host."""
+    """Routable address of this host. gethostbyname(gethostname()) often
+    resolves to 127.0.1.1 via /etc/hosts; the UDP-connect trick reads the
+    address the kernel would route externally (no packet is sent)."""
     try:
-        return socket.gethostbyname(socket.gethostname())
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
+            sock.connect(("8.8.8.8", 80))
+            return sock.getsockname()[0]
     except OSError:
-        return "127.0.0.1"
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
 
 
 def find_free_port() -> int:

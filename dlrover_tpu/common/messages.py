@@ -32,8 +32,17 @@ _SAFE_BUILTINS = {
 
 class _RestrictedUnpickler(pickle.Unpickler):
     def find_class(self, module, name):
+        # Dotted names resolve attribute chains (e.g. "pickle.loads" via any
+        # allowed module) — never allow them.
+        if "." in name:
+            raise pickle.UnpicklingError(
+                f"forbidden dotted name in control-plane message: "
+                f"{module}.{name}"
+            )
         if module == "dlrover_tpu.common.messages":
-            return super().find_class(module, name)
+            candidate = globals().get(name)
+            if isinstance(candidate, type) and issubclass(candidate, Message):
+                return candidate
         if module == "builtins" and name in _SAFE_BUILTINS:
             return super().find_class(module, name)
         raise pickle.UnpicklingError(

@@ -97,7 +97,11 @@ class RendezvousManager:
         with self._lock:
             if self._check_rdzv_completed():
                 self._cut_round()
-            if node_rank in self._latest_world:
+            # A node still in the waiting list has re-joined for the NEXT
+            # round — the latest world is stale for it (it may contain dead
+            # peers), so report "still forming".
+            if (node_rank in self._latest_world
+                    and node_rank not in self._waiting):
                 return self._rdzv_round - 1, 0, dict(self._latest_world)
             return self._rdzv_round, 0, {}
 

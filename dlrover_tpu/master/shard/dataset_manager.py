@@ -41,6 +41,8 @@ class DatasetShardCheckpoint:
     todo: List[list]
     epoch: int
     completed_records: int = 0
+    # lazy-split huge datasets: records already materialized this epoch
+    sub_epoch_offset: int = 0
 
     def to_json(self) -> str:
         return json.dumps({
@@ -48,6 +50,7 @@ class DatasetShardCheckpoint:
             "todo": self.todo,
             "epoch": self.epoch,
             "completed_records": self.completed_records,
+            "sub_epoch_offset": self.sub_epoch_offset,
         })
 
     @classmethod
@@ -58,6 +61,7 @@ class DatasetShardCheckpoint:
             todo=[list(t) for t in d["todo"]],
             epoch=d["epoch"],
             completed_records=d.get("completed_records", 0),
+            sub_epoch_offset=d.get("sub_epoch_offset", 0),
         )
 
 
@@ -179,6 +183,7 @@ class BatchDatasetManager:
             todo=todo,
             epoch=self._splitter.get_epoch(),
             completed_records=self._completed_records,
+            sub_epoch_offset=getattr(self._splitter, "_sub_epoch_offset", 0),
         )
 
     def restore_checkpoint(self, ckpt: DatasetShardCheckpoint) -> None:
@@ -187,6 +192,8 @@ class BatchDatasetManager:
         self.todo.clear()
         self.doing.clear()
         self._splitter.epoch = ckpt.epoch
+        if hasattr(self._splitter, "_sub_epoch_offset"):
+            self._splitter._sub_epoch_offset = ckpt.sub_epoch_offset
         self._completed_records = ckpt.completed_records
         for item in ckpt.todo:
             start, end = item[0], item[1]

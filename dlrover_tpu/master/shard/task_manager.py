@@ -23,11 +23,9 @@ from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
 
 
 class TaskManager:
-    def __init__(self, worker_restart_timeout_s: float = 0.0):
+    def __init__(self):
         self._lock = threading.Lock()
         self._datasets: Dict[str, BatchDatasetManager] = {}
-        self._worker_last_task_time: Dict[int, float] = {}
-        self._worker_restart_timeout_s = worker_restart_timeout_s
         self.speed_monitor = None   # wired by the job master
 
     # -- dataset registration ---------------------------------------------
@@ -60,7 +58,6 @@ class TaskManager:
             dataset = self._datasets.get(dataset_name)
             if dataset is None:
                 return Task(task_id=-1, dataset_name=dataset_name)
-            self._worker_last_task_time[worker_id] = time.time()
             return dataset.get_task(worker_id)
 
     def report_dataset_task(self, dataset_name: str, task_id: int,

@@ -1,0 +1,14 @@
+"""Model families (flax.linen, logical-axis annotated).
+
+Capability parity: the reference trains GPT-style models in its examples
+(examples/pytorch/nanogpt/train.py) and large LLaMA/GLM-family models through
+atorch (atorch/modules/transformer/layers.py LlamaAttentionFA etc.). Here:
+
+- gpt.py   — nanoGPT-equivalent (LayerNorm, learned positions, GELU MLP)
+- llama.py — LLaMA family (RMSNorm, RoPE, GQA, SwiGLU), the flagship for
+  benchmarks; params carry logical axis names that
+  dlrover_tpu.parallel.sharding maps onto the device mesh.
+"""
+
+from dlrover_tpu.models.gpt import GPT, GPTConfig
+from dlrover_tpu.models.llama import Llama, LlamaConfig

@@ -1,0 +1,124 @@
+"""GPT-2 style model (nanoGPT-equivalent) in flax.linen.
+
+Capability parity: the reference's end-to-end example model
+(examples/pytorch/nanogpt/model.py, trained via ElasticTrainer in
+examples/pytorch/nanogpt/train.py:289). Same logical-axis annotations as the
+LLaMA family so every parallel strategy applies unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.ops.flash_attention import (
+    flash_attention,
+    reference_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    block_size: int = 1024
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "flash"
+
+    @classmethod
+    def nano(cls, **kw) -> "GPTConfig":
+        kw.setdefault("vocab_size", 256)
+        return cls(n_embd=128, n_layer=4, n_head=4, block_size=128, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPTConfig":
+        kw.setdefault("vocab_size", 128)
+        return cls(n_embd=64, n_layer=2, n_head=2, block_size=64, **kw)
+
+
+def _logical(init, *axes):
+    return nn.with_logical_partitioning(init, axes)
+
+
+class Block(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        batch, seq, _ = x.shape
+        head_dim = cfg.n_embd // cfg.n_head
+
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        qkv = nn.Dense(
+            3 * cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02),
+                                 "embed", "heads"),
+            bias_init=_logical(nn.initializers.zeros, "heads"),
+            name="qkv",
+        )(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (
+            t.reshape(batch, seq, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+            for t in (q, k, v)
+        )
+        if cfg.attn_impl == "flash":
+            attn = flash_attention(q, k, v, True)
+        else:
+            attn = reference_attention(q, k, v, True)
+        attn = attn.transpose(0, 2, 1, 3).reshape(batch, seq, cfg.n_embd)
+        x = x + nn.Dense(
+            cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02),
+                                 "heads", "embed"),
+            bias_init=_logical(nn.initializers.zeros, "embed"),
+            name="attn_out",
+        )(attn)
+
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        h = nn.Dense(
+            4 * cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02),
+                                 "embed", "mlp"),
+            bias_init=_logical(nn.initializers.zeros, "mlp"),
+            name="fc",
+        )(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(
+            cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02),
+                                 "mlp", "embed"),
+            bias_init=_logical(nn.initializers.zeros, "embed"),
+            name="proj",
+        )(h)
+        return x
+
+
+class GPT(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        cfg = self.config
+        wte = self.param(
+            "wte", _logical(nn.initializers.normal(0.02), "vocab", "embed"),
+            (cfg.vocab_size, cfg.n_embd), cfg.param_dtype,
+        )
+        wpe = self.param(
+            "wpe", _logical(nn.initializers.normal(0.02), None, "embed"),
+            (cfg.block_size, cfg.n_embd), cfg.param_dtype,
+        )
+        seq = tokens.shape[-1]
+        x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[:seq]
+        for layer in range(cfg.n_layer):
+            x = Block(cfg, name=f"block_{layer}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # weight-tied LM head (as nanoGPT)
+        return jnp.dot(x, wte.astype(cfg.dtype).T).astype(jnp.float32)

@@ -1,0 +1,12 @@
+"""TPU-native kernels (Pallas) + reference implementations.
+
+Capability parity with the reference's native-op layer (SURVEY.md §2.3):
+- flash attention  ≙ atorch flash-attn integration
+  (atorch/modules/transformer/layers.py FA modules) — here a Pallas TPU
+  kernel with custom VJP
+- fused norms      ≙ atorch/normalization/layernorm.py (apex fused LN)
+- quantization     ≙ atorch/ops/csrc/{quantize,dequantize,...}.cu
+"""
+
+from dlrover_tpu.ops.flash_attention import flash_attention
+from dlrover_tpu.ops.norms import fused_rms_norm

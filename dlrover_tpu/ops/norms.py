@@ -1,0 +1,138 @@
+"""Fused RMSNorm / LayerNorm Pallas kernels.
+
+TPU-native equivalent of the reference's fused normalization
+(atorch/atorch/normalization/layernorm.py:157-237, an apex-CUDA-backed
+autograd function): one VMEM-resident kernel per (rows-block), fp32 math,
+custom VJP with a fused backward.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _rms_fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    o_ref[:] = (x * rstd * w).astype(o_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dwp_ref,
+                    *, eps: float):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dwp_ref[:] = jnp.zeros_like(dwp_ref)
+
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    xhat = x * rstd
+    wg = g * w
+    # dx = rstd * (wg - xhat * mean(wg * xhat))
+    mean_term = jnp.mean(wg * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = (rstd * (wg - xhat * mean_term)).astype(dx_ref.dtype)
+    # dw accumulates into a single (8, dim) block across the sequential
+    # grid; the partial is split evenly over 8 sublanes (exact: /8) and the
+    # caller sums the rows.
+    partial = jnp.sum(g * xhat, axis=0, keepdims=True) * 0.125
+    dwp_ref[:] += jnp.broadcast_to(partial, dwp_ref.shape)
+
+
+def _rows_block(n_rows: int) -> int:
+    return min(n_rows, 256)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fused_rms_norm(x: jax.Array, weight: jax.Array,
+                   eps: float = 1e-6) -> jax.Array:
+    """RMSNorm over the last dim: x * rsqrt(mean(x^2) + eps) * weight.
+
+    Accepts any leading shape; rows are processed in VMEM blocks.
+    """
+    out, _ = _rms_fwd(x, weight, eps)
+    return out
+
+
+def _rms_fwd(x, weight, eps):
+    orig_shape = x.shape
+    dim = orig_shape[-1]
+    x2 = x.reshape(-1, dim)
+    rows = x2.shape[0]
+    block = _rows_block(rows)
+    grid = ((rows + block - 1) // block,)
+    out, rstd = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block, dim), lambda i: (i, 0)),
+            pl.BlockSpec((dim,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, dim), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, x.dtype),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(x2, weight)
+    return out.reshape(orig_shape), (x2, weight, rstd, orig_shape)
+
+
+def _rms_fwd_vjp(x, weight, eps):
+    return _rms_fwd(x, weight, eps)
+
+
+def _rms_bwd_vjp(eps, res, g):
+    x2, weight, rstd, orig_shape = res
+    dim = x2.shape[1]
+    rows = x2.shape[0]
+    g2 = g.reshape(-1, dim)
+    block = _rows_block(rows)
+    n_blocks = (rows + block - 1) // block
+    dx, dw_partial = pl.pallas_call(
+        functools.partial(_rms_bwd_kernel, eps=eps),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((block, dim), lambda i: (i, 0)),
+            pl.BlockSpec((dim,), lambda i: (0,)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, dim), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, dim), lambda i: (i, 0)),
+            pl.BlockSpec((8, dim), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+            jax.ShapeDtypeStruct((8, dim), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(x2, weight, rstd, g2)
+    dw = dw_partial.sum(axis=0).astype(weight.dtype)
+    return dx.reshape(orig_shape), dw
+
+
+fused_rms_norm.defvjp(_rms_fwd_vjp, _rms_bwd_vjp)
+
+
+def reference_rms_norm(x, weight, eps: float = 1e-6):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)

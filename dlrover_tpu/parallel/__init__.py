@@ -1,0 +1,16 @@
+"""Parallelism layer: named-axis device meshes + sharding rules.
+
+Capability parity: atorch's process-group zoo (create_parallel_group,
+atorch/distributed/distributed.py:323; Megatron TP layer family,
+modules/distributed_modules/layers.py) — re-designed TPU-first: one
+`jax.sharding.Mesh` with named axes (data/fsdp/tensor/sequence/expert/pipe),
+logical-axis rules instead of parallel module classes, and XLA-inserted
+collectives over ICI/DCN.
+"""
+
+from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
+from dlrover_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    make_sharding_rules,
+    mesh_shardings,
+)

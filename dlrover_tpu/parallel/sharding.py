@@ -1,0 +1,70 @@
+"""Logical-axis → mesh-axis sharding rules.
+
+Capability parity: the reference's per-strategy module surgery (Megatron
+col/row-parallel classes layers.py:239-670, FSDP wrapping
+zero_optimization.py:215, MIP graph-sharding planners) collapses into ONE
+table: model params carry logical names (embed/heads/kv/mlp/vocab/norm) and
+these rules decide which mesh axis each maps to. Changing the strategy is
+changing the table — the model code never changes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.common.constants import MeshAxis
+
+# (logical axis, mesh axis or None). Megatron mapping: column-parallel
+# weights shard their output dim ("heads"/"mlp"/"vocab" → tensor), row-
+# parallel shard their input dim; FSDP shards the long "embed" dim.
+DEFAULT_RULES: List[Tuple[str, Optional[Any]]] = [
+    ("vocab", MeshAxis.TENSOR),
+    ("heads", MeshAxis.TENSOR),
+    ("kv", MeshAxis.TENSOR),
+    ("mlp", MeshAxis.TENSOR),
+    ("embed", MeshAxis.FSDP),
+    ("norm", None),
+]
+
+
+def make_sharding_rules(
+    fsdp: bool = True,
+    tensor: bool = True,
+    extra: Sequence[Tuple[str, Optional[str]]] = (),
+) -> List[Tuple[str, Optional[Any]]]:
+    rules = []
+    for logical, axis in DEFAULT_RULES:
+        if axis == MeshAxis.TENSOR and not tensor:
+            axis = None
+        if axis == MeshAxis.FSDP and not fsdp:
+            axis = None
+        rules.append((logical, axis))
+    rules.extend(extra)
+    return rules
+
+
+def mesh_shardings(tree: Any, mesh: Mesh,
+                   rules: Optional[Sequence[Tuple[str, Any]]] = None) -> Any:
+    """Variables/abstract pytree (with nn.Partitioned annotations) →
+    matching tree of NamedSharding."""
+    rules = list(rules if rules is not None else DEFAULT_RULES)
+    logical_specs = nn.get_partition_spec(tree)
+    return nn.logical_to_mesh_sharding(logical_specs, mesh, rules)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Global-batch arrays sharded over (data, fsdp)."""
+    return NamedSharding(mesh, P((MeshAxis.DATA, MeshAxis.FSDP)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def unbox(tree: Any) -> Any:
+    """Strip nn.Partitioned boxes (for code that wants raw arrays)."""
+    return nn.unbox(tree)

@@ -1,0 +1,184 @@
+"""Sharded training step builder with gradient accumulation.
+
+Capability parity: ElasticTrainer's fixed-global-batch gradient accumulation
+(dlrover/trainer/torch/elastic/trainer.py:53-139 GradientState/no_sync
+machinery) — TPU re-design: microbatches are a `lax.scan` inside ONE jitted
+program; the whole state (params + optimizer) is laid out by logical-axis
+rules over the mesh, so DP/FSDP/TP are a table change, not a wrapper class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.common.constants import MeshAxis
+from dlrover_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    mesh_shardings,
+)
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+@dataclasses.dataclass
+class ShardedTrainer:
+    """A lowered (mesh-specific) training program.
+
+    Rebuild via `build_trainer` after an elastic world resize — compiled
+    programs are mesh-shape-specific (SURVEY.md §7 'hard parts').
+    """
+
+    mesh: Mesh
+    init_fn: Callable[[jax.Array], TrainState]
+    step_fn: Callable[..., Tuple[TrainState, dict]]
+    state_shardings: Any
+    batch_sharding: NamedSharding
+    accum_steps: int
+    micro_batch: int
+
+    def init(self, rng: jax.Array) -> TrainState:
+        return self.init_fn(rng)
+
+    def step(self, state: TrainState, tokens, targets):
+        return self.step_fn(state, tokens, targets)
+
+    def shard_batch(self, tokens, targets):
+        """Host numpy (global_batch, seq) → device arrays shaped
+        (accum, micro, seq) with the micro axis over (data, fsdp)."""
+        accum, micro = self.accum_steps, self.micro_batch
+        tokens = tokens.reshape(accum, micro, *tokens.shape[1:])
+        targets = targets.reshape(accum, micro, *targets.shape[1:])
+        put = lambda x: jax.device_put(x, self.batch_sharding)
+        return put(tokens), put(targets)
+
+
+def build_trainer(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    sample_batch: jax.Array,
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    accum_steps: int = 1,
+    micro_batch: int = 1,
+    rules: Optional[Sequence] = None,
+    donate_state: bool = True,
+) -> ShardedTrainer:
+    """Lower (model, optimizer, mesh) into init/step programs.
+
+    sample_batch: one microbatch of tokens, shape (micro_batch, seq) — used
+    only for shape inference.
+    """
+    rules = list(rules if rules is not None else DEFAULT_RULES)
+
+    def _init_boxed(rng):
+        variables = model.init(rng, sample_batch)
+        params = variables["params"]
+        # optax maps over the boxed tree, so optimizer moments inherit the
+        # logical axis annotations (→ FSDP shards them like the params)
+        opt_state = tx.init(params)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state)
+
+    rng_spec = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    abstract_boxed = jax.eval_shape(
+        _init_boxed, jax.random.key(0)
+    )
+    state_shardings = mesh_shardings(abstract_boxed, mesh, rules)
+    batch_shard = NamedSharding(
+        mesh, P(None, (MeshAxis.DATA, MeshAxis.FSDP))
+    )
+
+    init_fn = jax.jit(
+        lambda rng: nn.unbox(_init_boxed(rng)),
+        out_shardings=state_shardings,
+    )
+
+    def _train_step(state: TrainState, tokens, targets):
+        params = state.params
+
+        def micro_step(carry, micro):
+            loss_acc, grad_acc = carry
+            tok, tgt = micro
+            def compute_loss(p):
+                logits = model.apply({"params": p}, tok)
+                return loss_fn(logits, tgt)
+
+            loss, grads = jax.value_and_grad(compute_loss)(params)
+            grad_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), grad_acc, grads
+            )
+            return (loss_acc + loss, grad_acc), None
+
+        zero_grads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            micro_step, (jnp.zeros((), jnp.float32), zero_grads),
+            (tokens, targets),
+        )
+        grads = jax.tree.map(
+            lambda g, p: (g / accum_steps).astype(p.dtype), grad_sum, params
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt)
+        metrics = {
+            "loss": loss_sum / accum_steps,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    step_fn = jax.jit(
+        _train_step,
+        in_shardings=(state_shardings, batch_shard, batch_shard),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+    return ShardedTrainer(
+        mesh=mesh,
+        init_fn=init_fn,
+        step_fn=step_fn,
+        state_shardings=state_shardings,
+        batch_sharding=batch_shard,
+        accum_steps=accum_steps,
+        micro_batch=micro_batch,
+    )
+
+
+def choose_accumulation(global_batch: int, dp_size: int,
+                        max_micro_per_replica: int) -> Tuple[int, int]:
+    """Pick (accum_steps, micro_batch_global) holding the global batch fixed
+    as the world resizes (reference: ElasticTrainer trainer.py:225 —
+    acc = max_workers / cur_workers).
+
+    micro_batch_global = global_batch / accum must divide by dp_size and fit
+    per-replica memory (micro/dp ≤ max_micro_per_replica).
+    """
+    if global_batch % dp_size:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by dp size {dp_size}"
+        )
+    per_replica_total = global_batch // dp_size
+    accum = 1
+    while (per_replica_total % accum
+           or per_replica_total // accum > max_micro_per_replica):
+        accum += 1
+        if accum > per_replica_total:
+            accum = per_replica_total
+            break
+    return accum, global_batch // accum

@@ -142,3 +142,23 @@ class TestMessageSecurity:
 
         with pytest.raises(Exception):
             deserialize_message(pickle.dumps(Gadget()))
+
+    def test_dotted_name_bypass_rejected(self):
+        """STACK_GLOBAL of ('dlrover_tpu.common.messages', 'pickle.loads')
+        must not resolve (dotted-name attribute chain bypass)."""
+        payload = (
+            b"\x80\x04\x95.\x00\x00\x00\x00\x00\x00\x00"
+            b"\x8c\x1cdlrover_tpu.common.messages\x8c\x0cpickle.loads\x93."
+        )
+        with pytest.raises(Exception):
+            deserialize_message(payload)
+
+    def test_non_message_class_in_module_rejected(self):
+        """Classes in the messages module that are not Message subclasses
+        (e.g. the unpickler itself) must not resolve."""
+        payload = (
+            b"\x80\x04\x95:\x00\x00\x00\x00\x00\x00\x00"
+            b"\x8c\x1cdlrover_tpu.common.messages\x8c\x15_RestrictedUnpickler\x93."
+        )
+        with pytest.raises(Exception):
+            deserialize_message(payload)

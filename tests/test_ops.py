@@ -1,0 +1,119 @@
+"""Numerics tests for Pallas kernels (interpret mode on the CPU platform)
+vs plain-XLA oracles. Reference analogue:
+atorch/tests/test_modules/test_flash_attn.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops.flash_attention import (
+    flash_attention,
+    reference_attention,
+)
+from dlrover_tpu.ops.norms import fused_rms_norm, reference_rms_norm
+
+
+def _qkv(batch=1, heads=2, kv_heads=None, seq=128, dim=64, dtype=jnp.float32,
+         seed=0):
+    kv_heads = kv_heads or heads
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(keys[0], (batch, heads, seq, dim), dtype)
+    k = jax.random.normal(keys[1], (batch, kv_heads, seq, dim), dtype)
+    v = jax.random.normal(keys[2], (batch, kv_heads, seq, dim), dtype)
+    return q, k, v
+
+
+class TestFlashAttentionForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv(seq=256, dim=64)
+        out = flash_attention(q, k, v, causal, None, 128, 128)
+        ref = reference_attention(q, k, v, causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_uneven_seq_blocks(self):
+        # seq not a multiple of block size exercises padding-free path
+        q, k, v = _qkv(seq=128, dim=64)
+        out = flash_attention(q, k, v, True, None, 64, 32)
+        ref = reference_attention(q, k, v, True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_gqa(self):
+        q, k, v = _qkv(heads=4, kv_heads=2, seq=128, dim=64)
+        out = flash_attention(q, k, v, True, None, 64, 64)
+        ref = reference_attention(q, k, v, True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_bf16(self):
+        q, k, v = _qkv(seq=128, dim=64, dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, True, None, 64, 64)
+        ref = reference_attention(q, k, v, True)
+        np.testing.assert_allclose(
+            out.astype(jnp.float32), ref.astype(jnp.float32),
+            atol=2e-2, rtol=2e-2,
+        )
+
+
+class TestFlashAttentionBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, causal):
+        q, k, v = _qkv(seq=128, dim=64)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal, None, 64, 64)
+                           ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+    def test_gqa_grads(self):
+        q, k, v = _qkv(heads=4, kv_heads=2, seq=64, dim=64)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True, None, 64, 64) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, True) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+class TestFusedRmsNorm:
+    def test_forward(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 256))
+        w = jax.random.normal(jax.random.PRNGKey(1), (256,)) + 1.0
+        np.testing.assert_allclose(
+            fused_rms_norm(x, w), reference_rms_norm(x, w),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    def test_backward(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 256))
+        w = jax.random.normal(jax.random.PRNGKey(1), (256,)) + 1.0
+
+        def loss_fused(x, w):
+            return jnp.sum(fused_rms_norm(x, w) ** 2)
+
+        def loss_ref(x, w):
+            return jnp.sum(reference_rms_norm(x, w) ** 2)
+
+        gx_f, gw_f = jax.grad(loss_fused, argnums=(0, 1))(x, w)
+        gx_r, gw_r = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(gx_f, gx_r, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(gw_f, gw_r, atol=1e-4, rtol=1e-4)
+
+    def test_under_jit_and_grad_composition(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 128))
+        w = jnp.ones((128,))
+        f = jax.jit(lambda x: fused_rms_norm(x, w).sum())
+        assert np.isfinite(float(f(x)))
+        assert np.isfinite(float(jax.jit(jax.grad(f))(x).sum()))

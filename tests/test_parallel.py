@@ -1,0 +1,144 @@
+"""Parallel-layer tests on the virtual 8-device CPU mesh: mesh factory,
+sharding rules, and — the load-bearing check — dp/fsdp/tp sharded training
+producing the same losses as single-device training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.common.constants import MeshAxis
+from dlrover_tpu.models.llama import Llama, LlamaConfig, cross_entropy_loss
+from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh, dp_size
+from dlrover_tpu.parallel.sharding import make_sharding_rules
+from dlrover_tpu.trainer.train_step import (
+    build_trainer,
+    choose_accumulation,
+)
+
+
+class TestMeshSpec:
+    def test_infer_data_dim(self, cpu_devices):
+        spec = MeshSpec(tensor=2).with_total_devices(8)
+        assert spec.data == 4 and spec.total == 8
+
+    def test_from_pairs(self):
+        spec = MeshSpec.from_pairs([("data", 2), ("tensor", 4)])
+        assert spec.data == 2 and spec.tensor == 4
+
+    def test_bad_axis_rejected(self):
+        with pytest.raises(ValueError):
+            MeshSpec.from_pairs([("bogus", 2)])
+
+    def test_mesh_axes_always_present(self, cpu_devices):
+        mesh = create_mesh(MeshSpec(data=8), cpu_devices)
+        assert set(mesh.axis_names) == set(MeshAxis.ALL)
+        assert dp_size(mesh) == 8
+
+    def test_indivisible_rejected(self, cpu_devices):
+        with pytest.raises(ValueError):
+            create_mesh(MeshSpec(tensor=3), cpu_devices)
+
+
+class TestChooseAccumulation:
+    def test_fits_without_accum(self):
+        assert choose_accumulation(32, 8, 4) == (1, 32)
+
+    def test_accumulates_when_needed(self):
+        accum, micro = choose_accumulation(32, 2, 4)
+        assert accum * micro == 32 and micro // 2 <= 4
+        # world shrank 8 -> 2: global batch unchanged
+        assert accum == 4
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            choose_accumulation(30, 8, 4)
+
+
+def _setup(mesh, accum=1, micro=8, seq=16):
+    cfg = LlamaConfig.tiny(attn_impl="reference", dtype=jnp.float32)
+    model = Llama(cfg)
+    tx = optax.adam(1e-3)
+    sample = jnp.zeros((micro, seq), jnp.int32)
+    trainer = build_trainer(model, tx, mesh, sample, cross_entropy_loss,
+                            accum_steps=accum, micro_batch=micro)
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (accum * micro, seq), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=-1)
+    return trainer, np.asarray(tokens), np.asarray(targets)
+
+
+def _run(trainer, tokens, targets, steps=3):
+    state = trainer.init(jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(steps):
+        tok, tgt = trainer.shard_batch(tokens, targets)
+        state, metrics = trainer.step(state, tok, tgt)
+        losses.append(float(metrics["loss"]))
+    return losses, state
+
+
+class TestShardedTraining:
+    def test_single_device_baseline(self, cpu_devices):
+        mesh = create_mesh(MeshSpec(data=1), cpu_devices[:1])
+        trainer, tokens, targets = _setup(mesh)
+        losses, _ = _run(trainer, tokens, targets)
+        assert losses[-1] < losses[0]
+
+    @pytest.mark.parametrize("spec", [
+        MeshSpec(data=8),                       # pure DP
+        MeshSpec(data=2, fsdp=4),               # DP × FSDP
+        MeshSpec(fsdp=2, tensor=4),             # FSDP × TP
+        MeshSpec(data=2, fsdp=2, tensor=2),     # 3D
+    ])
+    def test_sharded_matches_single_device(self, cpu_devices, spec):
+        mesh1 = create_mesh(MeshSpec(data=1), cpu_devices[:1])
+        trainer1, tokens, targets = _setup(mesh1)
+        base_losses, _ = _run(trainer1, tokens, targets)
+
+        mesh = create_mesh(spec, cpu_devices)
+        trainer, _, _ = _setup(mesh)
+        losses, state = _run(trainer, tokens, targets)
+        np.testing.assert_allclose(losses, base_losses, atol=1e-4,
+                                   rtol=1e-4)
+
+    def test_fsdp_actually_shards_params_and_opt_state(self, cpu_devices):
+        mesh = create_mesh(MeshSpec(fsdp=4, data=2), cpu_devices)
+        trainer, tokens, targets = _setup(mesh)
+        state = trainer.init(jax.random.PRNGKey(0))
+        embed = state.params["embed"]
+        # embed: (vocab, hidden); hidden (logical "embed") over fsdp=4
+        shard_shape = embed.sharding.shard_shape(embed.shape)
+        assert shard_shape[1] == embed.shape[1] // 4
+        # adam moments shard identically
+        mu_embed = state.opt_state[0].mu["embed"]
+        assert (mu_embed.sharding.shard_shape(mu_embed.shape)
+                == shard_shape)
+
+    def test_grad_accum_matches_large_batch(self, cpu_devices):
+        mesh = create_mesh(MeshSpec(data=2), cpu_devices[:2])
+        trainer_big, tokens, targets = _setup(mesh, accum=1, micro=8)
+        trainer_acc, _, _ = _setup(mesh, accum=4, micro=2)
+        losses_big, _ = _run(trainer_big, tokens, targets, steps=2)
+        losses_acc, _ = _run(trainer_acc, tokens, targets, steps=2)
+        np.testing.assert_allclose(losses_big, losses_acc, atol=1e-4,
+                                   rtol=1e-4)
+
+    def test_tensor_rules_disabled(self, cpu_devices):
+        """tensor=1 mesh with tensor rules off still trains."""
+        mesh = create_mesh(MeshSpec(data=8), cpu_devices)
+        cfg = LlamaConfig.tiny(attn_impl="reference", dtype=jnp.float32)
+        model = Llama(cfg)
+        sample = jnp.zeros((8, 16), jnp.int32)
+        trainer = build_trainer(
+            model, optax.sgd(1e-2), mesh, sample, cross_entropy_loss,
+            accum_steps=1, micro_batch=8,
+            rules=make_sharding_rules(fsdp=False, tensor=False),
+        )
+        state = trainer.init(jax.random.PRNGKey(0))
+        tokens = np.zeros((8, 16), np.int32)
+        tok, tgt = trainer.shard_batch(tokens, tokens)
+        state, metrics = trainer.step(state, tok, tgt)
+        assert np.isfinite(metrics["loss"])

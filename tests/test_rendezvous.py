@@ -177,3 +177,16 @@ class TestRendezvousOverflow:
         _, _, world = mgr.get_comm_world(0)
         assert len(world) == 2
         assert mgr.num_nodes_waiting() == 1
+
+    def test_rejoined_node_sees_forming_not_stale_world(self):
+        """A node that re-joined for the next round must not receive the
+        previous round's world (it may contain dead peers)."""
+        mgr = make_mgr(2, 2, wait=3600.0)
+        mgr.join_rendezvous(0, 4)
+        mgr.join_rendezvous(1, 4)
+        _, _, world0 = mgr.get_comm_world(0)
+        assert world0
+        mgr.remove_alive_node(1)     # node 1 died
+        mgr.join_rendezvous(0, 4)    # node 0 restarts, re-joins
+        _, _, world = mgr.get_comm_world(0)
+        assert world == {}           # round 1 still forming

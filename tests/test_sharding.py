@@ -247,3 +247,31 @@ class TestTextShardCheckpoint:
         got = {tuple(task.shard.indices or ())
                for task in list(restored.todo)}
         assert tuple(original_indices) in got
+
+
+class TestHugeDatasetCheckpoint:
+    def test_sub_epoch_offset_survives_restore(self):
+        splitter = TableDatasetSplitter("h", 100, 1, num_epochs=1,
+                                        max_shard_count=10)
+        mgr = BatchDatasetManager(TaskType.TRAINING, splitter)
+        # drain the first sub-epoch chunk (10 shards)
+        for _ in range(10):
+            t = mgr.get_task(0)
+            mgr.report_task_status(t.task_id, True)
+        ckpt = mgr.checkpoint()
+        assert ckpt.sub_epoch_offset == 10
+        fresh = BatchDatasetManager(
+            TaskType.TRAINING,
+            TableDatasetSplitter("h", 100, 1, num_epochs=1,
+                                 max_shard_count=10),
+        )
+        fresh.restore_checkpoint(ckpt)
+        starts = set()
+        while True:
+            t = fresh.get_task(0)
+            if t.is_empty or t.task_type != TaskType.TRAINING:
+                break
+            starts.add(t.shard.start)
+            fresh.report_task_status(t.task_id, True)
+        # records [0, 10) must never be re-dispatched
+        assert starts == set(range(10, 100))
