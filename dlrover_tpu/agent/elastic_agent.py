@@ -1,0 +1,204 @@
+"""Node-side elastic agent: rendezvous, spawn, monitor, restart.
+
+Capability parity: dlrover/python/elastic_agent/torch/training.py —
+``ElasticTrainingAgent`` (rendezvous :315, monitor/restart loop :429-521,
+failure reporting :490) re-designed for JAX workers:
+
+- One agent per TPU host. The worker it spawns is ONE JAX process that owns
+  all local chips (torch spawns one proc per GPU; JAX is one proc per host).
+- Rendezvous yields {node_rank → local chip count}; the agent derives
+  ``jax.distributed`` (num_processes, process_id) and the round's coordinator
+  address, published through the master KV store (replacing the reference's
+  MasterKVStore/c10d bootstrap, elastic_agent/torch/master_kv_store.py).
+- On worker failure: report to master, re-rendezvous, respawn (restart
+  budget). On membership change (``num_nodes_waiting > 0``): graceful
+  restart so the world re-forms — training re-lowers to the new mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.bootstrap import publish_or_wait_coordinator
+from dlrover_tpu.common.constants import (
+    DefaultValues,
+    NodeEnv,
+    RendezvousName,
+    TrainingMsgLevel,
+)
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    """What to run on this node."""
+
+    entrypoint: List[str]                    # argv of the training process
+    devices_per_node: int = 1                # local chip count
+    max_restarts: int = DefaultValues.MAX_RELAUNCH
+    monitor_interval_s: float = DefaultValues.MONITOR_INTERVAL_S
+    rdzv_timeout_s: float = DefaultValues.RDZV_TIMEOUT_S
+    # SIGTERM → SIGKILL grace: must cover one train step + a forced
+    # checkpoint commit (the worker saves on SIGTERM, elastic_loop.py).
+    shutdown_grace_s: float = 120.0
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class RendezvousTimeoutError(TimeoutError):
+    pass
+
+
+class ElasticAgent:
+    """Joins the master rendezvous and keeps one training process alive."""
+
+    def __init__(self, client: MasterClient, spec: WorkerSpec,
+                 rdzv_name: str = RendezvousName.TRAINING):
+        self._client = client
+        self._spec = spec
+        self._rdzv_name = rdzv_name
+        self._restart_count = 0
+        self._proc: Optional[subprocess.Popen] = None
+        self.last_world: Dict[int, int] = {}
+        self.last_round = -1
+
+    # -- rendezvous --------------------------------------------------------
+    def rendezvous(self) -> Tuple[int, Dict[int, int]]:
+        """Join and poll until this node is in a completed world
+        (reference: MasterRendezvousHandler.next_rendezvous training.py:180).
+        """
+        spec = self._spec
+        self._client.join_rendezvous(spec.devices_per_node, self._rdzv_name)
+        deadline = time.time() + spec.rdzv_timeout_s
+        while time.time() < deadline:
+            rdzv_round, _, world = self._client.get_comm_world(
+                self._rdzv_name
+            )
+            if world and self._client.node_rank in world:
+                self.last_world, self.last_round = world, rdzv_round
+                return rdzv_round, world
+            time.sleep(0.5)
+        raise RendezvousTimeoutError(
+            f"rendezvous {self._rdzv_name!r} did not complete within "
+            f"{spec.rdzv_timeout_s:.0f}s"
+        )
+
+    def _bootstrap_env(self, rdzv_round: int,
+                       world: Dict[int, int]) -> Dict[str, str]:
+        """Derive the JAX process set for this round; the lowest rank
+        publishes the coordinator address via the master KV store."""
+        ranks = sorted(world)
+        process_id = ranks.index(self._client.node_rank)
+        coord = publish_or_wait_coordinator(
+            self._client, f"coord/{self._rdzv_name}/{rdzv_round}",
+            process_id, self._spec.rdzv_timeout_s,
+        )
+        env = dict(os.environ)
+        env.update(self._spec.env)
+        env.update({
+            NodeEnv.MASTER_ADDR: self._client.master_addr,
+            NodeEnv.NODE_ID: str(self._client.node_id),
+            NodeEnv.NODE_RANK: str(self._client.node_rank),
+            NodeEnv.WORLD_SIZE: str(len(ranks)),
+            NodeEnv.PROCESS_ID: str(process_id),
+            NodeEnv.COORDINATOR_ADDR: coord,
+            NodeEnv.RDZV_ROUND: str(rdzv_round),
+            NodeEnv.DEVICES_PER_NODE: str(self._spec.devices_per_node),
+        })
+        return env
+
+    # -- worker lifecycle --------------------------------------------------
+    def _spawn(self) -> None:
+        rdzv_round, world = self.rendezvous()
+        env = self._bootstrap_env(rdzv_round, world)
+        logger.info(
+            "spawning worker (round %d, world %s, restart %d): %s",
+            rdzv_round, sorted(world), self._restart_count,
+            self._spec.entrypoint,
+        )
+        self._proc = subprocess.Popen(self._spec.entrypoint, env=env)
+
+    def _stop_worker(self) -> None:
+        if self._proc is None or self._proc.poll() is not None:
+            return
+        self._proc.send_signal(signal.SIGTERM)
+        try:
+            self._proc.wait(self._spec.shutdown_grace_s)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait()
+
+    def _restart_worker(self, count_against_budget: bool) -> None:
+        """Membership-change restarts are normal elasticity and do NOT
+        consume the failure budget (reference: torchelastic only charges
+        the budget on the failure path)."""
+        self._stop_worker()
+        if count_against_budget:
+            self._restart_count += 1
+        self._spawn()
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> int:
+        """Monitor loop (reference: _invoke_run training.py:429-521).
+        Returns the worker's final exit code."""
+        self._spawn()
+        spec = self._spec
+        while True:
+            time.sleep(spec.monitor_interval_s)
+            code = self._proc.poll()
+            if code is not None:
+                if code == 0:
+                    logger.info("worker finished successfully")
+                    return 0
+                self._client.report_failure(
+                    f"worker exit code {code}",
+                    level=TrainingMsgLevel.PROCESS_ERROR,
+                    restart_count=self._restart_count,
+                )
+                if self._restart_count >= spec.max_restarts:
+                    logger.error(
+                        "worker failed (exit %d) with restart budget "
+                        "exhausted (%d)", code, spec.max_restarts,
+                    )
+                    return code
+                logger.warning(
+                    "worker failed (exit %d); restarting (%d/%d)",
+                    code, self._restart_count + 1, spec.max_restarts,
+                )
+                self._restart_worker(count_against_budget=True)
+                continue
+            # Healthy: restart on membership change so the world re-forms
+            # (reference: training.py:483-486,510-521).
+            try:
+                waiting = self._client.num_nodes_waiting(self._rdzv_name)
+            except Exception:  # master transiently unreachable
+                waiting = 0
+            if waiting > 0:
+                logger.info(
+                    "%d node(s) waiting: restarting worker to re-form the "
+                    "world", waiting,
+                )
+                self._restart_worker(count_against_budget=False)
+
+    def shutdown(self) -> None:
+        self._stop_worker()
+
+
+def init_distributed() -> None:
+    """Training-process entry: initialize jax.distributed from the agent's
+    env contract. No-op single-process (standalone runs)."""
+    world_size = int(os.getenv(NodeEnv.WORLD_SIZE, "1"))
+    if world_size <= 1:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=os.environ[NodeEnv.COORDINATOR_ADDR],
+        num_processes=world_size,
+        process_id=int(os.environ[NodeEnv.PROCESS_ID]),
+    )

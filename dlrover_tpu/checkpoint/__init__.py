@@ -1,0 +1,6 @@
+"""Flash checkpoint: async sharded save/restore with reshard-on-restore."""
+
+from dlrover_tpu.checkpoint.flash_checkpoint import (  # noqa: F401
+    FlashCheckpointer,
+    abstract_state_for,
+)

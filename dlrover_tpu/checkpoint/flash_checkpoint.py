@@ -1,0 +1,138 @@
+"""Flash checkpoint: async sharded save/restore + data position.
+
+Capability parity: the subsystem the reference names "Flash Checkpoint" but
+leaves as a TODO (`ElasticTrainer` checkpoint hook raises NotImplementedError,
+dlrover/trainer/torch/elastic/trainer.py:295-319); its FSDP precedents are
+`save_fsdp_flat_param`/`ShardOptim`/`ShardTensorUtil` (atorch/utils/
+fsdp_save_util.py:98,179,222,364 — safetensors shards + reshard-on-restore)
+and the master-side dataset-position checkpoint (`DatasetShardCheckpoint`,
+master/shard/base_dataset_manager.py:60).
+
+TPU re-design on Orbax:
+- **Async save**: `ocp.CheckpointManager` commits in a background thread;
+  the train loop only pays the device→host copy (the same role as the
+  reference's shared-memory staging).
+- **Reshard-on-restore**: the restore target is an *abstract* state carrying
+  the NEW mesh's shardings — Orbax reads each shard from disk directly into
+  the new layout, which is the TPU-native equivalent of `ShardTensorUtil`'s
+  FSDP→TP conversion. Works across any mesh-shape change (elastic resize).
+- **Data position**: a JSON item saved atomically with the model state
+  (sampler state_dict + master shard checkpoint), so a restored job resumes
+  mid-epoch without replaying or dropping data.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.parallel.sharding import mesh_shardings
+
+_MODEL_ITEM = "state"
+_DATA_ITEM = "data"
+
+
+def abstract_state_for(init_fn, mesh, rules=None, *args) -> Any:
+    """Abstract TrainState (shapes + NEW-mesh shardings) for restore.
+
+    init_fn: the *boxed* state initializer (returns nn.Partitioned-annotated
+    pytree); args are example inputs (e.g. a PRNG key).
+    """
+    abstract = jax.eval_shape(init_fn, *args)
+    shardings = mesh_shardings(abstract, mesh, rules)
+    import flax.linen as nn
+
+    abstract = nn.unbox(abstract)
+    return jax.tree.map(
+        lambda leaf, sharding: jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=sharding),
+        abstract, shardings,
+    )
+
+
+class FlashCheckpointer:
+    """Interval + on-demand async checkpointing of (TrainState, data state).
+
+    One instance per training process; all processes participate in the
+    sharded save (each writes its own shards), process 0 writes metadata.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        save_interval_steps: int = 100,
+        max_to_keep: int = 3,
+    ):
+        self._directory = directory
+        self._save_interval = save_interval_steps
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=True,
+        )
+        self._manager = ocp.CheckpointManager(
+            directory, options=options,
+            item_names=(_MODEL_ITEM, _DATA_ITEM),
+        )
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def maybe_save(self, step: int, state: Any,
+                   data_state: Optional[Dict[str, Any]] = None,
+                   force: bool = False) -> bool:
+        """Save if at an interval boundary (or force=True, e.g. membership
+        change / preemption notice). Returns whether a save started."""
+        if not force and (self._save_interval <= 0
+                          or step % self._save_interval != 0 or step == 0):
+            return False
+        with self._lock:
+            args = ocp.args.Composite(**{
+                _MODEL_ITEM: ocp.args.StandardSave(state),
+                _DATA_ITEM: ocp.args.JsonSave(data_state or {}),
+            })
+            saved = self._manager.save(step, args=args, force=force)
+        if saved:
+            logger.info("flash checkpoint: async save started at step %d",
+                        step)
+        return saved
+
+    def restore(self, abstract_state: Any
+                ) -> Optional[Tuple[Any, Dict[str, Any], int]]:
+        """Restore the latest checkpoint INTO the abstract state's shardings
+        (reshard-on-restore). Returns (state, data_state, step) or None."""
+        step = self._manager.latest_step()
+        if step is None:
+            return None
+        restored = self._manager.restore(
+            step,
+            args=ocp.args.Composite(**{
+                _MODEL_ITEM: ocp.args.StandardRestore(abstract_state),
+                _DATA_ITEM: ocp.args.JsonRestore(),
+            }),
+        )
+        logger.info("flash checkpoint: restored step %d", step)
+        return restored[_MODEL_ITEM], restored[_DATA_ITEM] or {}, step
+
+    # ------------------------------------------------------------------
+    def wait(self) -> None:
+        """Block until in-flight async saves are committed."""
+        self._manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def all_steps(self):
+        return self._manager.all_steps()
+
+    def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._manager.close()
+
+    def __enter__(self) -> "FlashCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,31 @@
+"""Shared process-set bootstrap helpers (agent + diagnostics probes).
+
+The rank-0 member of a rendezvous world publishes the jax.distributed
+coordinator address through the master KV store; everyone else blocks on the
+key. This replaces the reference's c10d TCPStore bootstrap
+(elastic_agent/torch/master_kv_store.py).
+"""
+
+from __future__ import annotations
+
+import socket
+
+from dlrover_tpu.common.comm import local_ip
+
+
+def free_port() -> int:
+    sock = socket.socket()
+    sock.bind(("", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def publish_or_wait_coordinator(client, key: str, process_id: int,
+                                timeout_s: float) -> str:
+    """Rank 0 publishes `ip:port` under `key`; others wait for it."""
+    if process_id == 0:
+        coord = f"{local_ip()}:{free_port()}"
+        client.kv_set(key, coord.encode())
+        return coord
+    return client.kv_wait(key, timeout_s=timeout_s).decode()

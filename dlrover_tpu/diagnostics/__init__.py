@@ -1,0 +1,1 @@
+"""Health diagnostics: ICI/DCN probes, fault isolation, straggler detection."""

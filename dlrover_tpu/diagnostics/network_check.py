@@ -1,0 +1,180 @@
+"""Network check: 2-round paired ICI/DCN probe + straggler detection.
+
+Capability parity: dlrover's `--network-check` path — the agent runs a
+diagnostic task before training (elastic_agent/torch/training.py:681-874
+NetworkCheckElasticAgent; probe task trainer/torch/run_network_check.py:30-92
+does matmul + repeated allgather and writes elapsed time to a file); the
+master groups nodes in pairs (round 0 adjacent, round 1
+fastest-with-slowest), isolates nodes that fail BOTH rounds as faulty, and
+flags elapsed > 2×median as stragglers (rdzv_manager.py:299-461).
+
+TPU re-design: the probe is a fresh JAX subprocess per round (a JAX process
+can only initialize one distributed runtime, and each round re-forms the
+group). Within the pair group it runs a bf16 matmul burst (MXU sanity) and
+repeated `jax.lax.all_gather` over every chip of the pair (ICI/DCN sanity)
+under `shard_map`, then writes elapsed seconds to a result file the agent
+reports to the master.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.bootstrap import publish_or_wait_coordinator
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.common.log import default_logger as logger
+
+_RESULT_FILE_ENV = "DLROVER_TPU_NC_RESULT_FILE"
+_MATMUL_SIZE = 4096
+_ALLGATHER_FLOATS = 1 << 20
+_ROUNDS = 2
+_REPEATS = 10
+
+
+# ---------------------------------------------------------------------------
+# Probe subprocess
+# ---------------------------------------------------------------------------
+
+
+def probe_main() -> int:
+    """Entry for `python -m dlrover_tpu.diagnostics.network_check`.
+
+    Initializes jax.distributed within the pair group from the agent env
+    contract, runs the probe, writes `{"elapsed": s}` to the result file.
+    """
+    result_file = os.environ[_RESULT_FILE_ENV]
+    from dlrover_tpu.agent.elastic_agent import init_distributed
+
+    init_distributed()
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    # MXU sanity: a bf16 matmul burst on every local chip.
+    x = jnp.ones((_MATMUL_SIZE, _MATMUL_SIZE), jnp.bfloat16)
+    for _ in range(3):
+        x = jnp.tanh(x @ x * 1e-4)
+    jax.block_until_ready(x)
+    # ICI/DCN sanity: repeated all-gather across every chip in the group.
+    n = jax.device_count()
+    if n > 1:
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        mesh = Mesh(jax.devices(), ("probe",))
+        data = jnp.ones((n, _ALLGATHER_FLOATS), jnp.float32)
+
+        @jax.jit
+        def gather_sum(arr):
+            def inner(block):
+                gathered = jax.lax.all_gather(block, "probe")
+                return jnp.sum(gathered, dtype=jnp.float32)[None]
+
+            return shard_map(
+                inner, mesh=mesh, in_specs=P("probe"), out_specs=P("probe")
+            )(arr)
+
+        for _ in range(_REPEATS):
+            out = gather_sum(data)
+        jax.block_until_ready(out)
+        expected = float(n * _ALLGATHER_FLOATS)
+        if abs(float(out[0]) - expected) > 1e-3 * expected:
+            raise RuntimeError(
+                f"allgather result {float(out[0])} != {expected}"
+            )
+    elapsed = time.perf_counter() - t0
+    with open(result_file, "w") as f:
+        json.dump({"elapsed": elapsed}, f)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Agent-side driver
+# ---------------------------------------------------------------------------
+
+
+def _probe_round(client: MasterClient, devices_per_node: int,
+                 timeout_s: float) -> Tuple[bool, float]:
+    """Join one NETWORK_CHECK round, run the probe in the pair group,
+    return (normal, elapsed)."""
+    rdzv = RendezvousName.NETWORK_CHECK
+    client.join_rendezvous(devices_per_node, rdzv)
+    deadline = time.time() + timeout_s
+    while True:
+        rdzv_round, group, world = client.get_comm_world(rdzv)
+        if world and client.node_rank in world:
+            break
+        if time.time() > deadline:
+            return False, 0.0
+        time.sleep(0.5)
+
+    ranks = sorted(world)
+    process_id = ranks.index(client.node_rank)
+    coord = publish_or_wait_coordinator(
+        client, f"coord/{rdzv}/{rdzv_round}/{group}", process_id, timeout_s,
+    )
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        result_file = f.name
+    env = dict(os.environ)
+    env.update({
+        NodeEnv.WORLD_SIZE: str(len(ranks)),
+        NodeEnv.PROCESS_ID: str(process_id),
+        NodeEnv.COORDINATOR_ADDR: coord,
+        _RESULT_FILE_ENV: result_file,
+    })
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "dlrover_tpu.diagnostics.network_check"],
+            env=env, timeout=timeout_s,
+        )
+        normal = proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        normal = False
+    elapsed = time.perf_counter() - t0
+    try:
+        with open(result_file) as f:
+            elapsed = json.load(f)["elapsed"]
+    except Exception:
+        normal = False
+    finally:
+        try:
+            os.unlink(result_file)
+        except OSError:
+            pass
+    return normal, elapsed
+
+
+def run_network_check(client: MasterClient, devices_per_node: int = 1,
+                      exclude_straggler: bool = False,
+                      timeout_s: float = 300.0) -> bool:
+    """Run the 2-round probe and ask the master for the verdict. Returns
+    whether this node may join training (reference: training.py:681-733)."""
+    for check_round in range(_ROUNDS):
+        normal, elapsed = _probe_round(client, devices_per_node, timeout_s)
+        logger.info("network check round %d: normal=%s elapsed=%.2fs",
+                    check_round, normal, elapsed)
+        client.report_network_status(normal, elapsed)
+    verdict = client.get_network_check_verdict()
+    if not verdict.normal:
+        logger.error("network check: this node is FAULTY (%s)",
+                     verdict.reason)
+        return False
+    if verdict.is_straggler:
+        logger.warning("network check: this node is a STRAGGLER")
+        if exclude_straggler:
+            return False
+    return True
+
+
+if __name__ == "__main__":
+    raise SystemExit(probe_main())

@@ -1,0 +1,148 @@
+"""`dlrover-tpu-run` — the elastic launcher CLI.
+
+Capability parity: dlrover/trainer/torch/elastic_run.py (the `dlrover-run`
+torchrun superset: `--nnodes min:max`, `--standalone` auto-spawning a local
+master :184-209, `--network-check`, `--max-restarts`) re-designed for JAX:
+one agent per TPU host spawns ONE JAX process owning all local chips.
+
+Usage:
+    dlrover-tpu-run --standalone train.py --lr 3e-4
+    dlrover-tpu-run --nnodes 2:4 --node-rank $RANK \
+        --master-addr $DLROVER_TPU_MASTER_ADDR train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.agent.elastic_agent import ElasticAgent, WorkerSpec
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import DefaultValues, NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def _parse_nnodes(value: str) -> Tuple[int, int]:
+    if ":" in value:
+        lo, hi = value.split(":", 1)
+        return int(lo), int(hi)
+    n = int(value)
+    return n, n
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        "dlrover-tpu-run", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--nnodes", default="1",
+                        help="node count, fixed `N` or elastic `MIN:MAX`")
+    parser.add_argument("--node-rank", type=int,
+                        default=int(os.getenv(NodeEnv.NODE_RANK, "0")))
+    parser.add_argument("--master-addr",
+                        default=os.getenv(NodeEnv.MASTER_ADDR, ""))
+    parser.add_argument("--standalone", action="store_true",
+                        help="run a local in-process master (single host)")
+    parser.add_argument("--max-restarts", type=int,
+                        default=DefaultValues.MAX_RELAUNCH)
+    parser.add_argument("--monitor-interval", type=float,
+                        default=DefaultValues.MONITOR_INTERVAL_S)
+    parser.add_argument("--devices-per-node", type=int, default=0,
+                        help="local chip count (0 = autodetect lazily)")
+    parser.add_argument("--network-check", action="store_true",
+                        help="run the ICI/DCN probe before training "
+                             "(reference: dlrover-run --network-check)")
+    parser.add_argument("--exclude-straggler", action="store_true",
+                        help="exit instead of training when this node is "
+                             "flagged as a straggler by the probe")
+    parser.add_argument("--node-unit", type=int, default=1)
+    parser.add_argument("--no-python", action="store_true",
+                        help="run the entrypoint as a raw command")
+    parser.add_argument("entrypoint", help="training script (or command)")
+    parser.add_argument("entry_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def _detect_devices() -> int:
+    env = os.getenv(NodeEnv.DEVICES_PER_NODE)
+    if env:
+        return int(env)
+    # Detect in a short-lived subprocess: importing jax here would
+    # initialize the TPU runtime in the AGENT process and hold the chips,
+    # so the spawned training process could never acquire them.
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.local_device_count())"],
+            capture_output=True, text=True, timeout=120,
+        )
+        return int(out.stdout.strip().splitlines()[-1])
+    except Exception:
+        return 1
+
+
+def run(args: argparse.Namespace) -> int:
+    min_nodes, max_nodes = _parse_nnodes(args.nnodes)
+    master = None
+    master_addr = args.master_addr
+    if args.standalone:
+        from dlrover_tpu.master.job_master import JobMaster
+
+        master = JobMaster(min_nodes=min_nodes, max_nodes=max_nodes,
+                           node_unit=args.node_unit, host="127.0.0.1")
+        master.prepare()
+        master_addr = master.addr
+        logger.info("standalone master at %s", master_addr)
+    if not master_addr:
+        raise SystemExit(
+            "--master-addr (or DLROVER_TPU_MASTER_ADDR) is required unless "
+            "--standalone"
+        )
+
+    entrypoint = list(args.entry_args)
+    if args.no_python:
+        entrypoint.insert(0, args.entrypoint)
+    else:
+        entrypoint = [sys.executable, args.entrypoint] + entrypoint
+
+    client = MasterClient(master_addr, node_id=args.node_rank,
+                          node_rank=args.node_rank)
+    devices = args.devices_per_node or _detect_devices()
+    spec = WorkerSpec(
+        entrypoint=entrypoint,
+        devices_per_node=devices,
+        max_restarts=args.max_restarts,
+        monitor_interval_s=args.monitor_interval,
+    )
+    agent = ElasticAgent(client, spec)
+    try:
+        if args.network_check:
+            from dlrover_tpu.diagnostics.network_check import (
+                run_network_check,
+            )
+
+            ok = run_network_check(
+                client, devices, exclude_straggler=args.exclude_straggler
+            )
+            if not ok:
+                logger.error("network check verdict: this node must not "
+                             "join training")
+                return 3
+        return agent.run()
+    finally:
+        agent.shutdown()
+        client.close()
+        if master is not None:
+            master.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,148 @@
+"""Elastic agent tests: spawn/monitor/restart against an in-process master.
+
+Mirrors the reference strategy (SURVEY.md §4): agent logic runs against a
+local master, workers are trivial subprocesses — no cluster, no chips.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.elastic_agent import ElasticAgent, WorkerSpec
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.master.job_master import JobMaster
+
+
+@pytest.fixture()
+def master():
+    m = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def _client(master, rank=0):
+    return MasterClient(master.addr, node_id=rank, node_rank=rank)
+
+
+def _spec(entry, **kw):
+    kw.setdefault("monitor_interval_s", 0.1)
+    kw.setdefault("rdzv_timeout_s", 30.0)
+    return WorkerSpec(entrypoint=entry, **kw)
+
+
+def test_agent_runs_worker_to_success(master, tmp_path):
+    out = tmp_path / "done.txt"
+    client = _client(master)
+    agent = ElasticAgent(client, _spec(
+        [sys.executable, "-c",
+         f"open({str(out)!r}, 'w').write('ok')"]))
+    assert agent.run() == 0
+    assert out.read_text() == "ok"
+    assert agent.last_world == {0: 1}
+    client.close()
+
+
+def test_agent_restarts_failed_worker(master, tmp_path):
+    marker = tmp_path / "marker"
+    script = (
+        "import os, sys\n"
+        f"p = {str(marker)!r}\n"
+        "if not os.path.exists(p):\n"
+        "    open(p, 'w').close()\n"
+        "    sys.exit(7)\n"
+    )
+    client = _client(master)
+    agent = ElasticAgent(client, _spec([sys.executable, "-c", script],
+                                       max_restarts=2))
+    assert agent.run() == 0
+    assert agent._restart_count == 1
+    client.close()
+
+
+def test_agent_exhausts_restart_budget(master):
+    client = _client(master)
+    agent = ElasticAgent(client, _spec(
+        [sys.executable, "-c", "import sys; sys.exit(5)"], max_restarts=1))
+    assert agent.run() == 5
+    client.close()
+
+
+def test_agent_restarts_on_membership_change(tmp_path):
+    m = JobMaster(min_nodes=1, max_nodes=2, host="127.0.0.1")
+    m.prepare()
+    try:
+        count_file = tmp_path / "count"
+        # First spawn sleeps long; after restart, exits fast. The worker
+        # appends a line per spawn.
+        script = (
+            "import time\n"
+            f"p = {str(count_file)!r}\n"
+            "with open(p, 'a') as f:\n"
+            "    f.write('x')\n"
+            "n = len(open(p).read())\n"
+            "time.sleep(60 if n == 1 else 0)\n"
+        )
+        client0 = _client(m, 0)
+        agent = ElasticAgent(client0, _spec([sys.executable, "-c", script]))
+        result = {}
+
+        def _run():
+            result["code"] = agent.run()
+
+        thread = threading.Thread(target=_run, daemon=True)
+        thread.start()
+        # Wait for the first worker to spawn (round 1 complete).
+        deadline = time.time() + 20
+        while time.time() < deadline and not count_file.exists():
+            time.sleep(0.1)
+        assert count_file.exists()
+
+        # A second node joins → agent must restart the worker.
+        client1 = _client(m, 1)
+        client1.join_rendezvous(local_world_size=1)
+        thread.join(timeout=30)
+        assert result.get("code") == 0
+        assert len(count_file.read_text()) == 2
+        assert sorted(agent.last_world) == [0, 1]
+        client0.close()
+        client1.close()
+    finally:
+        m.stop()
+
+
+def test_run_cli_standalone(tmp_path):
+    from dlrover_tpu import run as run_mod
+
+    out = tmp_path / "cli.txt"
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os\n"
+        "assert os.environ['DLROVER_TPU_MASTER_ADDR']\n"
+        "assert os.environ['DLROVER_TPU_WORLD_SIZE'] == '1'\n"
+        f"open({str(out)!r}, 'w').write('ran')\n"
+    )
+    code = run_mod.main([
+        "--standalone", "--monitor-interval", "0.1",
+        "--devices-per-node", "1", str(script),
+    ])
+    assert code == 0
+    assert out.read_text() == "ran"
+
+
+def test_network_check_single_node():
+    """Probe plumbing end-to-end with a 1-node group (matmul-only path)."""
+    from dlrover_tpu.diagnostics.network_check import run_network_check
+
+    m = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    m.prepare()
+    try:
+        client = _client(m)
+        assert run_network_check(client, devices_per_node=1,
+                                 timeout_s=120.0)
+        client.close()
+    finally:
+        m.stop()

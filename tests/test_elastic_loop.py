@@ -1,0 +1,101 @@
+"""E2E slice: ElasticTrainLoop with checkpoint-resume across a world resize.
+
+Mirrors the reference e2e story (SURVEY.md §7 step 3 / examples/pytorch/
+nanogpt): train, stop, resume on a different mesh with the same global
+batch, verify the loss keeps decreasing and data position is restored.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models.llama import Llama, LlamaConfig, cross_entropy_loss
+from dlrover_tpu.parallel.mesh import MeshSpec
+from dlrover_tpu.trainer.elastic_loop import ElasticTrainLoop, TrainLoopConfig
+from dlrover_tpu.trainer.sampler import ElasticDistributedSampler
+
+
+def _make_loop(cpu_devices, tmp_path, n_devices, global_batch=8,
+               max_steps=3, **spec_kw):
+    cfg = LlamaConfig.tiny(attn_impl="reference")
+    model = Llama(cfg)
+    tx = optax.adamw(1e-3)
+    loop = ElasticTrainLoop(
+        model, tx, cross_entropy_loss,
+        TrainLoopConfig(
+            global_batch=global_batch, seq_len=16,
+            max_micro_per_replica=4, max_steps=max_steps,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            save_interval_steps=1,
+            mesh_spec=MeshSpec(**spec_kw),
+        ),
+        devices=cpu_devices[:n_devices],
+    )
+    return cfg, loop
+
+
+def _batches(cfg, global_batch, seq, count, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(count):
+        tokens = rng.integers(0, cfg.vocab_size, (global_batch, seq),
+                              dtype=np.int32)
+        yield tokens, tokens  # autoregressive dummy
+
+
+def test_train_checkpoint_resume_resized_world(cpu_devices, tmp_path):
+    # Phase 1: 4 devices (dp=2 × tensor=2), 3 steps.
+    cfg, loop = _make_loop(cpu_devices, tmp_path, 4, tensor=2)
+    assert loop.dp == 2
+    sampler = ElasticDistributedSampler(1024, shuffle=False)
+    state, start = loop.restore_or_init(jax.random.PRNGKey(0), sampler)
+    assert start == 0
+    state, metrics = loop.run(
+        state, _batches(cfg, 8, 16, 10), start_step=0, sampler=sampler)
+    loss_phase1 = metrics["loss"]
+    assert np.isfinite(loss_phase1)
+    assert sampler.completed_num == 3 * 8
+    loop.close()
+    del state
+
+    # Phase 2: world resized to 2 devices; same global batch via more accum.
+    cfg, loop2 = _make_loop(cpu_devices, tmp_path, 2, max_steps=2)
+    assert loop2.dp == 2  # data(2)
+    sampler2 = ElasticDistributedSampler(1024, shuffle=False)
+    state2, start2 = loop2.restore_or_init(jax.random.PRNGKey(1), sampler2)
+    assert start2 == 3
+    assert sampler2.completed_num == 24
+    state2, metrics2 = loop2.run(
+        state2, _batches(cfg, 8, 16, 10, seed=1),
+        start_step=start2, sampler=sampler2)
+    assert np.isfinite(metrics2["loss"])
+    assert loop2.checkpointer.latest_step() == 5
+    loop2.close()
+
+
+def test_stop_request_forces_save(cpu_devices, tmp_path):
+    cfg, loop = _make_loop(cpu_devices, tmp_path, 2, max_steps=100)
+    loop.config = loop.config  # no-op; keep linters quiet
+    loop.checkpointer._save_interval = 1000  # interval never hit
+    state, _ = loop.restore_or_init(jax.random.PRNGKey(0))
+
+    def gen():
+        for i, batch in enumerate(_batches(cfg, 8, 16, 50)):
+            if i == 2:
+                loop._stop_requested.set()
+            yield batch
+
+    state, metrics = loop.run(state, gen())
+    assert loop.checkpointer.latest_step() == 3  # forced save on stop
+    loop.close()
+
+
+def test_global_batch_held_fixed():
+    """choose_accumulation keeps global batch constant as dp changes."""
+    from dlrover_tpu.trainer.train_step import choose_accumulation
+
+    for dp in (1, 2, 4, 8):
+        accum, micro = choose_accumulation(32, dp, max_micro_per_replica=4)
+        assert accum * micro == 32
+        assert micro // dp <= 4
