@@ -14,6 +14,18 @@ class PlatformType:
     RAY = "ray"
 
 
+class DistributionStrategy:
+    ALLREDUCE = "allreduce"   # SPMD data/model parallel over a mesh
+    PS = "ps"                 # parameter-server-style (elastic embeddings)
+    LOCAL = "local"
+
+
+class OptimizeMode:
+    MANUAL = "manual"
+    SINGLE_JOB = "single-job"
+    CLUSTER = "cluster"       # ask the brain service for resource plans
+
+
 class NodeType:
     MASTER = "master"
     WORKER = "worker"        # a TPU host running one JAX process

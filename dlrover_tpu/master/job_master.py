@@ -40,6 +40,8 @@ class JobMaster:
         max_nodes: int = 1,
         node_unit: int = 1,
         job_manager=None,
+        job_args=None,
+        cluster=None,
         host: str = "0.0.0.0",
     ):
         ctx = Context.singleton()
@@ -81,6 +83,23 @@ class JobMaster:
         )
         self._stopped = threading.Event()
         self._exit_reason = ""
+        if job_manager is None and job_args is not None:
+            from dlrover_tpu.master.node.event_callback import (
+                RendezvousMembershipCallback,
+                TaskRescheduleCallback,
+            )
+            from dlrover_tpu.master.node.job_manager import create_job_manager
+
+            manager = create_job_manager(
+                job_args, master_addr=self.addr,
+                speed_monitor=self.speed_monitor, cluster=cluster)
+            manager.add_event_callback(
+                TaskRescheduleCallback(self.task_manager))
+            manager.add_event_callback(
+                RendezvousMembershipCallback(self.rdzv_managers,
+                                             self.speed_monitor))
+            self.job_manager = manager
+            self.servicer.job_manager = manager
 
     # ------------------------------------------------------------------
     def prepare(self) -> None:

@@ -1,0 +1,5 @@
+"""Master-side node lifecycle management (reference: dlrover/python/master/node/)."""
+
+from dlrover_tpu.master.node.job_manager import JobManager, create_job_manager
+
+__all__ = ["JobManager", "create_job_manager"]

@@ -1,0 +1,71 @@
+"""Node-event callbacks: side effects of lifecycle transitions.
+
+Capability parity: dlrover/python/master/node/event_callback.py —
+TaskRescheduleCallback (:105) requeues a dead worker's in-flight shards;
+AllReduceNodeHandlingCallback (:212) maintains rendezvous membership and
+the speed monitor's running-worker set.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+
+
+class NodeEventCallback:
+    def on_node_started(self, node: Node) -> None:
+        pass
+
+    def on_node_succeeded(self, node: Node) -> None:
+        pass
+
+    def on_node_failed(self, node: Node) -> None:
+        pass
+
+    def on_node_deleted(self, node: Node) -> None:
+        pass
+
+
+class TaskRescheduleCallback(NodeEventCallback):
+    """Requeue the doing-tasks of a dead worker so other workers pick them
+    up (dynamic sharding fault tolerance)."""
+
+    def __init__(self, task_manager):
+        self._task_manager = task_manager
+
+    def on_node_failed(self, node: Node) -> None:
+        self._task_manager.recover_tasks(node.id)
+
+    def on_node_deleted(self, node: Node) -> None:
+        self._task_manager.recover_tasks(node.id)
+
+
+class RendezvousMembershipCallback(NodeEventCallback):
+    """Keep rendezvous managers' alive-node sets and the speed monitor in
+    sync with node lifecycle (the AllReduce path's membership bookkeeping)."""
+
+    def __init__(self, rdzv_managers: Dict[str, object], speed_monitor):
+        self._rdzv_managers = rdzv_managers
+        self._speed_monitor = speed_monitor
+
+    def on_node_started(self, node: Node) -> None:
+        for mgr in self._rdzv_managers.values():
+            mgr.add_alive_node(node.rank_index)
+
+    def _drop(self, node: Node) -> None:
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(node.rank_index)
+        self._speed_monitor.remove_running_worker(node.id)
+        self._speed_monitor.reset_running_speed()
+
+    def on_node_succeeded(self, node: Node) -> None:
+        self._drop(node)
+
+    def on_node_failed(self, node: Node) -> None:
+        logger.info("rendezvous membership: dropping failed %s", node.name)
+        self._drop(node)
+
+    def on_node_deleted(self, node: Node) -> None:
+        self._drop(node)

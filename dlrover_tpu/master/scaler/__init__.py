@@ -1,0 +1,6 @@
+"""Scale-plan actuation (reference: dlrover/python/master/scaler/)."""
+
+from dlrover_tpu.master.scaler.base import ScalePlan, Scaler
+from dlrover_tpu.master.scaler.local_scaler import LocalScaler
+
+__all__ = ["ScalePlan", "Scaler", "LocalScaler"]

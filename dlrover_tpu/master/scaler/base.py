@@ -1,0 +1,51 @@
+"""ScalePlan model + Scaler interface.
+
+Capability parity: dlrover/python/master/scaler/base_scaler.py — a plan
+names the target group sizes plus explicit node launches/removals; a
+Scaler actuates it against the platform.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from dlrover_tpu.common.node import Node, NodeGroupResource
+
+
+@dataclass
+class ScalePlan:
+    # Target per-type group size/resource ("scale to N workers of shape R").
+    node_group_resources: Dict[str, NodeGroupResource] = field(
+        default_factory=dict)
+    # Explicit node launches (relaunches carry rank/config of the dead node).
+    launch_nodes: List[Node] = field(default_factory=list)
+    # Explicit removals.
+    remove_nodes: List[Node] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return (not self.node_group_resources and not self.launch_nodes
+                and not self.remove_nodes)
+
+    def merge(self, other: "ScalePlan") -> None:
+        self.node_group_resources.update(other.node_group_resources)
+        self.launch_nodes.extend(other.launch_nodes)
+        self.remove_nodes.extend(other.remove_nodes)
+
+
+class Scaler(abc.ABC):
+    """Actuates ScalePlans (reference: Scaler base, pod_scaler.py:71)."""
+
+    def __init__(self, job_name: str):
+        self.job_name = job_name
+
+    @abc.abstractmethod
+    def scale(self, plan: ScalePlan) -> None:
+        ...
+
+    def start(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+    def stop(self) -> None:  # pragma: no cover - default no-op
+        pass

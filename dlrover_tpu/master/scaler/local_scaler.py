@@ -1,0 +1,84 @@
+"""Scaler against the in-memory LocalCluster.
+
+The local analog of PodScaler (reference: master/scaler/pod_scaler.py:130):
+creates/deletes PodRecords, carrying the same env contract the k8s path
+injects into containers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import NodeEnv, NodeStatus
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.scaler.base import ScalePlan, Scaler
+from dlrover_tpu.scheduler.local import LocalCluster, PodRecord
+
+
+class LocalScaler(Scaler):
+    def __init__(self, job_name: str, cluster: LocalCluster,
+                 master_addr: str = ""):
+        super().__init__(job_name)
+        self._cluster = cluster
+        self._master_addr = master_addr
+        self._lock = threading.Lock()
+        # max node id handed out per type, for group-size launches
+        self._next_id: Dict[str, int] = {}
+
+    def _alloc_id(self, node_type: str) -> int:
+        with self._lock:
+            next_id = self._next_id.get(node_type, 0)
+            self._next_id[node_type] = next_id + 1
+            return next_id
+
+    def register_existing(self, node_type: str, upto_id: int) -> None:
+        with self._lock:
+            self._next_id[node_type] = max(
+                self._next_id.get(node_type, 0), upto_id)
+
+    def _create(self, node: Node, node_num: int) -> None:
+        pod = PodRecord(
+            name=node.name,
+            node_type=node.type,
+            node_id=node.id,
+            rank_index=node.rank_index,
+            status=NodeStatus.PENDING,
+            env={
+                NodeEnv.MASTER_ADDR: self._master_addr,
+                NodeEnv.NODE_ID: str(node.id),
+                NodeEnv.NODE_RANK: str(node.rank_index),
+                NodeEnv.NODE_NUM: str(node_num),
+                NodeEnv.JOB_NAME: self.job_name,
+            },
+            resource=node.config_resource.to_dict(),
+        )
+        self._cluster.create_pod(pod)
+
+    def scale(self, plan: ScalePlan) -> None:
+        for node in plan.remove_nodes:
+            logger.info("scaler: removing %s", node.name)
+            self._cluster.delete_pod(node.name)
+        group_total: Optional[int] = None
+        for node_type, group in plan.node_group_resources.items():
+            existing = [p for p in self._cluster.list_pods(node_type)
+                        if p.status not in
+                        (NodeStatus.FAILED, NodeStatus.DELETED,
+                         NodeStatus.SUCCEEDED)]
+            group_total = group.count
+            delta = group.count - len(existing)
+            if delta > 0:
+                for _ in range(delta):
+                    node_id = self._alloc_id(node_type)
+                    node = Node(node_type, node_id,
+                                config_resource=group.node_resource)
+                    self._create(node, group.count)
+            elif delta < 0:
+                # remove highest-rank pods first (keeps ranks contiguous)
+                doomed = sorted(existing, key=lambda p: -p.rank_index)[:(-delta)]
+                for pod in doomed:
+                    logger.info("scaler: scaling down %s", pod.name)
+                    self._cluster.delete_pod(pod.name)
+        for node in plan.launch_nodes:
+            self._create(node, group_total or (node.rank_index + 1))

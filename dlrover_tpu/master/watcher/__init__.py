@@ -1,0 +1,6 @@
+"""Node event watchers (reference: dlrover/python/master/watcher/)."""
+
+from dlrover_tpu.master.watcher.base import NodeEvent, NodeWatcher
+from dlrover_tpu.master.watcher.local_watcher import LocalNodeWatcher
+
+__all__ = ["NodeEvent", "NodeWatcher", "LocalNodeWatcher"]

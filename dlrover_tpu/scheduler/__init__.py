@@ -1,0 +1,13 @@
+"""Platform scheduler abstraction (k8s / local / ray-style).
+
+Capability parity: dlrover/python/scheduler/ — `JobArgs` parsed per
+platform (scheduler/job.py:109, kubernetes.py:360), platform clients, and
+the factory. The local platform is a complete in-memory cluster used by
+tests and the standalone path, exactly like the reference's mocked
+k8sClient (tests/test_utils.py:238-253) but as a first-class backend.
+"""
+
+from dlrover_tpu.scheduler.job import JobArgs, NodeArgs
+from dlrover_tpu.scheduler.factory import new_platform_cluster
+
+__all__ = ["JobArgs", "NodeArgs", "new_platform_cluster"]

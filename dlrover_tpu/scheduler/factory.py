@@ -1,0 +1,20 @@
+"""Platform factory (reference: dlrover/python/scheduler/factory.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from dlrover_tpu.common.constants import PlatformType
+
+
+def new_platform_cluster(platform: str, namespace: str = "default",
+                         **kwargs: Any) -> Any:
+    if platform == PlatformType.LOCAL:
+        from dlrover_tpu.scheduler.local import LocalCluster
+
+        return LocalCluster(**kwargs)
+    if platform == PlatformType.KUBERNETES:
+        from dlrover_tpu.scheduler.kubernetes import K8sClient
+
+        return K8sClient(namespace=namespace, **kwargs)
+    raise ValueError(f"unknown platform {platform!r}")

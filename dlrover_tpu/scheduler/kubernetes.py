@@ -1,0 +1,264 @@
+"""Kubernetes platform client — zero-dependency REST against the API server.
+
+Capability parity: dlrover/python/scheduler/kubernetes.py (k8sClient :85,
+K8sElasticJob :327) without the `kubernetes` SDK (not in the image): a thin
+HTTPS client over the in-cluster service-account contract
+(/var/run/secrets/kubernetes.io/serviceaccount) with create/delete/list/watch
+on pods and services, plus the TPU pod-manifest builder. The manifest/
+watch-parsing logic is pure and unit-testable; network calls only happen on
+a real cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Iterator, List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv, NodeStatus
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import NodeResource
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# k8s pod phase → framework node status (reference: the reference maps the
+# same five phases in master/watcher/k8s_watcher.py).
+POD_PHASE_TO_STATUS = {
+    "Pending": NodeStatus.PENDING,
+    "Running": NodeStatus.RUNNING,
+    "Succeeded": NodeStatus.SUCCEEDED,
+    "Failed": NodeStatus.FAILED,
+    "Unknown": NodeStatus.UNKNOWN,
+}
+
+
+def in_cluster() -> bool:
+    return os.path.exists(os.path.join(_SA_DIR, "token"))
+
+
+class K8sApi:
+    """Minimal typed REST surface; swap out in tests."""
+
+    def __init__(self, host: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None):
+        self._host = host or "https://{}:{}".format(
+            os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default"),
+            os.environ.get("KUBERNETES_SERVICE_PORT", "443"),
+        )
+        if token is None and in_cluster():
+            with open(os.path.join(_SA_DIR, "token")) as f:
+                token = f.read().strip()
+        self._token = token
+        ca = ca_file or os.path.join(_SA_DIR, "ca.crt")
+        self._ssl = ssl.create_default_context(
+            cafile=ca if os.path.exists(ca) else None)
+        if not os.path.exists(ca):
+            self._ssl.check_hostname = False
+            self._ssl.verify_mode = ssl.CERT_NONE
+
+    def request(self, method: str, path: str,
+                body: Optional[Dict[str, Any]] = None,
+                timeout: float = 30.0) -> Dict[str, Any]:
+        req = urllib.request.Request(
+            self._host + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+        )
+        req.add_header("Accept", "application/json")
+        req.add_header("Content-Type", "application/json")
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=self._ssl) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def stream(self, path: str, timeout: float = 3600.0
+               ) -> Iterator[Dict[str, Any]]:
+        """Line-delimited watch stream."""
+        req = urllib.request.Request(self._host + path)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=self._ssl) as resp:
+            for line in resp:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
+
+class K8sClient:
+    """Pod/service CRUD in one namespace (reference: k8sClient,
+    scheduler/kubernetes.py:85-326)."""
+
+    def __init__(self, namespace: str = "default",
+                 api: Optional[K8sApi] = None):
+        self.namespace = namespace
+        self.api = api or K8sApi()
+
+    # -- pods ----------------------------------------------------------
+    def create_pod(self, manifest: Dict[str, Any]) -> bool:
+        try:
+            self.api.request(
+                "POST", f"/api/v1/namespaces/{self.namespace}/pods", manifest)
+            return True
+        except urllib.error.HTTPError as e:
+            logger.error("create_pod failed: %s %s", e.code, e.reason)
+            return False
+
+    def delete_pod(self, name: str) -> bool:
+        try:
+            self.api.request(
+                "DELETE", f"/api/v1/namespaces/{self.namespace}/pods/{name}")
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return True
+            logger.error("delete_pod failed: %s %s", e.code, e.reason)
+            return False
+
+    def list_pods(self, label_selector: str = "") -> List[Dict[str, Any]]:
+        path = f"/api/v1/namespaces/{self.namespace}/pods"
+        if label_selector:
+            path += f"?labelSelector={label_selector}"
+        return self.api.request("GET", path).get("items", [])
+
+    def watch_pods(self, label_selector: str = "",
+                   resource_version: str = "") -> Iterator[Dict[str, Any]]:
+        path = (f"/api/v1/namespaces/{self.namespace}/pods"
+                f"?watch=true&labelSelector={label_selector}")
+        if resource_version:
+            path += f"&resourceVersion={resource_version}"
+        return self.api.stream(path)
+
+    def create_service(self, manifest: Dict[str, Any]) -> bool:
+        try:
+            self.api.request(
+                "POST", f"/api/v1/namespaces/{self.namespace}/services",
+                manifest)
+            return True
+        except urllib.error.HTTPError as e:
+            logger.error("create_service failed: %s %s", e.code, e.reason)
+            return False
+
+    def patch_custom_resource(self, group: str, version: str, plural: str,
+                              name: str, body: Dict[str, Any]) -> bool:
+        """Patch a CR (scale-plan relay; reference: elasticjob_scaler.py)."""
+        path = (f"/apis/{group}/{version}/namespaces/{self.namespace}"
+                f"/{plural}/{name}")
+        try:
+            self.api.request("PATCH", path, body)
+            return True
+        except urllib.error.HTTPError as e:
+            logger.error("patch CR failed: %s %s", e.code, e.reason)
+            return False
+
+
+# ---------------------------------------------------------------------------
+# Pure manifest construction (unit-testable without a cluster).
+# ---------------------------------------------------------------------------
+
+def build_pod_manifest(
+    job_name: str,
+    node_type: str,
+    node_id: int,
+    rank_index: int,
+    image: str,
+    command: str,
+    master_addr: str,
+    node_num: int,
+    resource: Optional[NodeResource] = None,
+    tpu_topology: str = "",
+    labels: Optional[Dict[str, str]] = None,
+    owner_ref: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """A TPU worker pod with the framework env contract. TPU chips are
+    requested via the `google.com/tpu` device-plugin resource and the slice
+    topology via the GKE nodeSelector (reference analog: _create_pod,
+    master/scaler/pod_scaler.py:352 builds GPU pods with TF_CONFIG)."""
+    resource = resource or NodeResource()
+    name = f"{job_name}-{node_type}-{node_id}"
+    env = [
+        {"name": NodeEnv.MASTER_ADDR, "value": master_addr},
+        {"name": NodeEnv.NODE_ID, "value": str(node_id)},
+        {"name": NodeEnv.NODE_RANK, "value": str(rank_index)},
+        {"name": NodeEnv.NODE_NUM, "value": str(node_num)},
+        {"name": NodeEnv.JOB_NAME, "value": job_name},
+    ]
+    limits: Dict[str, Any] = {}
+    if resource.cpu:
+        limits["cpu"] = str(resource.cpu)
+    if resource.memory_mb:
+        limits["memory"] = f"{int(resource.memory_mb)}Mi"
+    if resource.chips:
+        limits["google.com/tpu"] = str(resource.chips)
+    node_selector: Dict[str, str] = {}
+    if resource.chip_type:
+        node_selector["cloud.google.com/gke-tpu-accelerator"] = (
+            resource.chip_type)
+    if tpu_topology:
+        node_selector["cloud.google.com/gke-tpu-topology"] = tpu_topology
+    manifest: Dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "labels": dict(labels or {}, **{
+                "dlrover-tpu/job": job_name,
+                "dlrover-tpu/type": node_type,
+                "dlrover-tpu/rank": str(rank_index),
+                "dlrover-tpu/node-id": str(node_id),
+            }),
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [{
+                "name": "main",
+                "image": image,
+                "command": ["/bin/sh", "-c", command] if command else None,
+                "env": env,
+                "resources": {"limits": limits, "requests": dict(limits)},
+                "ports": [{"containerPort": 8471}],  # TPU runtime port
+            }],
+            "nodeSelector": node_selector or None,
+        },
+    }
+    if owner_ref:
+        manifest["metadata"]["ownerReferences"] = [owner_ref]
+    container = manifest["spec"]["containers"][0]
+    manifest["spec"] = {k: v for k, v in manifest["spec"].items()
+                        if v is not None}
+    manifest["spec"]["containers"] = [
+        {k: v for k, v in container.items() if v is not None}]
+    return manifest
+
+
+def pod_to_fields(pod: Dict[str, Any]) -> Dict[str, Any]:
+    """Parse a pod object into the watcher's neutral fields (reference:
+    PodWatcher._convert_pod_event, master/watcher/k8s_watcher.py:130-193)."""
+    meta = pod.get("metadata", {})
+    labels = meta.get("labels", {})
+    status = pod.get("status", {})
+    exit_reason = ""
+    for cs in status.get("containerStatuses", []):
+        term = (cs.get("state", {}) or {}).get("terminated")
+        if term:
+            reason = term.get("reason", "")
+            if term.get("exitCode") == 137 or reason == "OOMKilled":
+                exit_reason = "oom"
+            elif reason == "Error":
+                exit_reason = "unknown_error"
+    return {
+        "name": meta.get("name", ""),
+        "node_type": labels.get("dlrover-tpu/type", ""),
+        "node_id": int(labels.get("dlrover-tpu/node-id", -1)),
+        "rank_index": int(labels.get("dlrover-tpu/rank", -1)),
+        "status": POD_PHASE_TO_STATUS.get(
+            status.get("phase", ""), NodeStatus.UNKNOWN),
+        "exit_reason": exit_reason,
+        "host_ip": status.get("hostIP", ""),
+        "pod_ip": status.get("podIP", ""),
+    }

@@ -1,0 +1,258 @@
+"""Job-manager / scaler / watcher / scheduler tests.
+
+Mirrors the reference's in-memory master tests (test_job_manager.py,
+test_pod_scaler.py, tests/test_utils.py mock cluster) — everything runs
+against the LocalCluster fake platform.
+"""
+
+import time
+
+from dlrover_tpu.common.constants import (
+    JobStage,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.node.job_manager import JobManager, create_job_manager
+from dlrover_tpu.master.scaler.base import ScalePlan
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.scheduler.job import JobArgs, NodeArgs
+from dlrover_tpu.scheduler.kubernetes import build_pod_manifest, pod_to_fields
+from dlrover_tpu.scheduler.local import LocalCluster
+
+
+def make_job_args(workers=3, restart_count=2):
+    args = JobArgs(job_name="test-job")
+    args.node_args[NodeType.WORKER] = NodeArgs(
+        group_resource=NodeGroupResource(
+            count=workers,
+            node_resource=NodeResource(cpu=4, memory_mb=8192, chips=4,
+                                       chip_type="v5p"),
+        ),
+        restart_count=restart_count,
+    )
+    return args
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def start_manager(workers=3, restart_count=2):
+    cluster = LocalCluster()
+    manager = create_job_manager(make_job_args(workers, restart_count),
+                                 master_addr="127.0.0.1:0",
+                                 speed_monitor=SpeedMonitor(),
+                                 cluster=cluster)
+    manager.start()
+    assert wait_until(
+        lambda: len(manager.get_running_workers()) == workers)
+    return cluster, manager
+
+
+class TestSchedulerArgs:
+    def test_from_spec_parses_replicas(self):
+        spec = {
+            "distributionStrategy": "allreduce",
+            "optimizeMode": "cluster",
+            "tpuTopology": "2x2x4",
+            "replicaSpecs": {
+                "worker": {
+                    "replicas": 4,
+                    "restartCount": 5,
+                    "resource": {"cpu": 8, "memoryMb": 16384,
+                                 "chips": 4, "chipType": "v5p"},
+                },
+            },
+        }
+        args = JobArgs.from_spec(spec, job_name="j1")
+        worker = args.node_args[NodeType.WORKER]
+        assert worker.group_resource.count == 4
+        assert worker.restart_count == 5
+        assert worker.group_resource.node_resource.chips == 4
+        assert args.tpu_topology == "2x2x4"
+        assert args.optimize_mode == "cluster"
+
+    def test_ps_defaults_critical(self):
+        spec = {"replicaSpecs": {"ps": {"replicas": 2}}}
+        args = JobArgs.from_spec(spec)
+        assert args.node_args[NodeType.PS].critical
+
+
+class TestPodManifest:
+    def test_build_and_parse_roundtrip(self):
+        manifest = build_pod_manifest(
+            job_name="j", node_type="worker", node_id=3, rank_index=3,
+            image="img", command="run", master_addr="1.2.3.4:50051",
+            node_num=8,
+            resource=NodeResource(cpu=8, memory_mb=4096, chips=4,
+                                  chip_type="tpu-v5p-slice"),
+            tpu_topology="2x2x1",
+        )
+        limits = manifest["spec"]["containers"][0]["resources"]["limits"]
+        assert limits["google.com/tpu"] == "4"
+        sel = manifest["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x2x1"
+        # simulate the pod coming back from the API server with status
+        manifest["status"] = {"phase": "Running", "podIP": "10.0.0.9"}
+        fields = pod_to_fields(manifest)
+        assert fields["node_id"] == 3
+        assert fields["status"] == NodeStatus.RUNNING
+        assert fields["pod_ip"] == "10.0.0.9"
+
+    def test_oom_exit_reason(self):
+        pod = {
+            "metadata": {"labels": {"dlrover-tpu/node-id": "0",
+                                    "dlrover-tpu/rank": "0",
+                                    "dlrover-tpu/type": "worker"}},
+            "status": {
+                "phase": "Failed",
+                "containerStatuses": [{
+                    "state": {"terminated": {"exitCode": 137,
+                                             "reason": "OOMKilled"}},
+                }],
+            },
+        }
+        assert pod_to_fields(pod)["exit_reason"] == "oom"
+
+
+class TestJobManagerLifecycle:
+    def test_initial_scale_creates_workers(self):
+        cluster, manager = start_manager(workers=3)
+        assert len(manager.get_running_workers()) == 3
+        manager.stop()
+
+    def test_failed_worker_is_relaunched(self):
+        cluster, manager = start_manager(workers=2)
+        victim = cluster.list_pods(NodeType.WORKER)[0]
+        cluster.fail_pod(victim.name, NodeExitReason.UNKNOWN_ERROR)
+        assert wait_until(
+            lambda: len([p for p in cluster.list_pods(NodeType.WORKER)
+                         if p.status == NodeStatus.RUNNING]) == 2)
+        # the replacement keeps the dead node's rank
+        nodes = manager.get_nodes(NodeType.WORKER)
+        relaunched = [n for n in nodes if n.relaunch_count == 1]
+        assert len(relaunched) == 1
+        assert relaunched[0].rank_index == victim.rank_index
+        assert manager.job_stage() == JobStage.RUNNING
+        manager.stop()
+
+    def test_oom_relaunch_bumps_memory(self):
+        cluster, manager = start_manager(workers=1)
+        victim = cluster.list_pods(NodeType.WORKER)[0]
+        cluster.fail_pod(victim.name, NodeExitReason.OOM)
+        assert wait_until(
+            lambda: any(n.relaunch_count == 1
+                        for n in manager.get_nodes(NodeType.WORKER)))
+        node = [n for n in manager.get_nodes(NodeType.WORKER)
+                if n.relaunch_count == 1][0]
+        assert node.config_resource.memory_mb > 8192
+        manager.stop()
+
+    def test_fatal_error_not_relaunched_job_fails(self):
+        cluster, manager = start_manager(workers=1, restart_count=3)
+        victim = cluster.list_pods(NodeType.WORKER)[0]
+        cluster.fail_pod(victim.name, NodeExitReason.FATAL_ERROR)
+        assert wait_until(
+            lambda: manager.job_stage() == JobStage.FAILED)
+        manager.stop()
+
+    def test_relaunch_budget_exhausted_fails_job(self):
+        cluster, manager = start_manager(workers=1, restart_count=1)
+        victim = cluster.list_pods(NodeType.WORKER)[0]
+        cluster.fail_pod(victim.name, NodeExitReason.UNKNOWN_ERROR)
+        assert wait_until(
+            lambda: any(n.relaunch_count == 1
+                        for n in manager.get_nodes(NodeType.WORKER)))
+        replacement = [p for p in cluster.list_pods(NodeType.WORKER)
+                       if p.status == NodeStatus.RUNNING][0]
+        cluster.fail_pod(replacement.name, NodeExitReason.UNKNOWN_ERROR)
+        assert wait_until(lambda: manager.job_stage() == JobStage.FAILED)
+        manager.stop()
+
+    def test_all_workers_succeed_job_succeeds(self):
+        cluster, manager = start_manager(workers=2)
+        for pod in cluster.list_pods(NodeType.WORKER):
+            cluster.set_status(pod.name, NodeStatus.SUCCEEDED)
+        assert wait_until(lambda: manager.job_stage() == JobStage.SUCCEEDED)
+        manager.stop()
+
+    def test_manual_scale_request(self):
+        from dlrover_tpu.common import messages as msg
+
+        cluster, manager = start_manager(workers=2)
+        manager.handle_scale_request(
+            msg.ScaleRequest(node_type=NodeType.WORKER, count=4))
+        assert wait_until(
+            lambda: len([p for p in cluster.list_pods(NodeType.WORKER)
+                         if p.status == NodeStatus.RUNNING]) == 4)
+        manager.handle_scale_request(
+            msg.ScaleRequest(node_type=NodeType.WORKER, count=1))
+        assert wait_until(
+            lambda: len([p for p in cluster.list_pods(NodeType.WORKER)
+                         if p.status == NodeStatus.RUNNING]) == 1)
+        # the surviving pod is rank 0 (scale-down trims top ranks)
+        assert cluster.list_pods(NodeType.WORKER)[0].rank_index == 0
+        manager.stop()
+
+
+class TestMasterIntegration:
+    def test_master_with_job_args_runs_to_success(self):
+        from dlrover_tpu.master.job_master import JobMaster
+
+        cluster = LocalCluster()
+        master = JobMaster(min_nodes=2, max_nodes=2,
+                           job_args=make_job_args(workers=2),
+                           cluster=cluster)
+        master.prepare()
+        assert wait_until(
+            lambda: len(master.job_manager.get_running_workers()) == 2)
+        thread = master.run_in_thread(poll_interval_s=0.1)
+        for pod in cluster.list_pods(NodeType.WORKER):
+            cluster.set_status(pod.name, NodeStatus.SUCCEEDED)
+        thread.join(timeout=5)
+        assert not thread.is_alive()
+        assert master.job_manager.job_stage() == JobStage.SUCCEEDED
+
+
+class TestEventCallbacks:
+    def test_membership_and_task_recovery_on_failure(self):
+        from dlrover_tpu.master.node.event_callback import (
+            RendezvousMembershipCallback,
+            TaskRescheduleCallback,
+        )
+        from dlrover_tpu.master.rendezvous import (
+            ElasticTrainingRendezvousManager,
+            RendezvousParameters,
+        )
+
+        class FakeTaskManager:
+            def __init__(self):
+                self.recovered = []
+
+            def recover_tasks(self, worker_id):
+                self.recovered.append(worker_id)
+
+        cluster = LocalCluster()
+        speed = SpeedMonitor()
+        rdzv = ElasticTrainingRendezvousManager(
+            RendezvousParameters(min_nodes=1, max_nodes=4))
+        task_manager = FakeTaskManager()
+        manager = create_job_manager(make_job_args(2), speed_monitor=speed,
+                                     cluster=cluster)
+        manager.add_event_callback(TaskRescheduleCallback(task_manager))
+        manager.add_event_callback(
+            RendezvousMembershipCallback({"training": rdzv}, speed))
+        manager.start()
+        assert wait_until(
+            lambda: len(manager.get_running_workers()) == 2)
+        victim = cluster.list_pods(NodeType.WORKER)[0]
+        cluster.fail_pod(victim.name, NodeExitReason.UNKNOWN_ERROR)
+        assert wait_until(lambda: victim.node_id in task_manager.recovered)
+        manager.stop()
