@@ -1,0 +1,25 @@
+"""Auto-acceleration: one-call strategy → lowered sharded trainer.
+
+Capability parity: atorch's `auto_accelerate` stack (atorch/auto/
+accelerate.py:391, model_context.py, opt_lib/optimization_library.py:38-53).
+TPU re-design: an optimization does not wrap modules — it edits an
+AccelerationPlan (mesh spec, logical-axis sharding rules, dtypes, remat
+policy, kernel choices, grad accumulation), and one final lowering compiles
+the whole plan into a jitted sharded train step. Strategies are declarative
+data, savable/loadable like atorch's strategy files.
+"""
+
+from dlrover_tpu.auto.accelerate import AccelerateResult, auto_accelerate
+from dlrover_tpu.auto.model_context import ModelContext
+from dlrover_tpu.auto.strategy import Strategy, load_strategy, save_strategy
+from dlrover_tpu.auto.opt_lib import OptimizationLibrary
+
+__all__ = [
+    "AccelerateResult",
+    "ModelContext",
+    "OptimizationLibrary",
+    "Strategy",
+    "auto_accelerate",
+    "load_strategy",
+    "save_strategy",
+]

@@ -1,0 +1,208 @@
+"""auto_accelerate: strategy → lowered sharded trainer.
+
+Capability parity: atorch auto_accelerate (atorch/auto/accelerate.py:391)
+and model_transform (:35). Three modes:
+- explicit strategy (load_strategy given): apply passes, lower, return —
+  the reference's skip-search path;
+- semi-auto (strategy="auto"): engine search over SEMIAUTO_STRATEGIES with
+  dry-run scoring (engine module);
+- default: a sensible TPU baseline (bf16 + flash attention; fsdp when the
+  mesh has >1 device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from dlrover_tpu.auto.model_context import ModelContext
+from dlrover_tpu.auto.opt_lib import OptimizationLibrary
+from dlrover_tpu.auto.strategy import (
+    Strategy,
+    load_strategy,
+    normalize_strategy,
+    save_strategy,
+)
+from dlrover_tpu.common.constants import MeshAxis
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
+from dlrover_tpu.parallel.sharding import make_sharding_rules
+from dlrover_tpu.trainer.train_step import (
+    ShardedTrainer,
+    build_trainer,
+    choose_accumulation,
+)
+
+
+@dataclasses.dataclass
+class AccelerateResult:
+    """What auto_accelerate hands back (the reference returns a tuple of
+    transformed model/optim/dataloader/loss; here the lowered trainer
+    carries them all)."""
+
+    trainer: ShardedTrainer
+    mesh: Any
+    model: Any
+    strategy: Strategy
+    context: ModelContext
+
+    # convenience passthroughs
+    def init(self, rng):
+        return self.trainer.init(rng)
+
+    def step(self, state, tokens, targets):
+        return self.trainer.step(state, tokens, targets)
+
+
+def default_strategy(n_devices: int) -> Strategy:
+    strategy: Strategy = [("half", {}), ("module_replace", {})]
+    if n_devices > 1:
+        strategy.append(("fsdp", {}))
+    return strategy
+
+
+def apply_strategy(context: ModelContext, strategy: Strategy,
+                   opt_lib: Optional[OptimizationLibrary] = None
+                   ) -> ModelContext:
+    """The model_transform analog (accelerate.py:35-66): run each pass."""
+    opt_lib = opt_lib or OptimizationLibrary()
+    opt_lib.validate_strategy(strategy)
+    for name, config in strategy:
+        opt_lib[name].apply(context, config)
+    return context
+
+
+def lower(context: ModelContext) -> AccelerateResult:
+    """Compile the accumulated plan into a mesh + jitted train step."""
+    plan = context.plan
+    n_devices = len(context.devices)
+
+    # -- mesh ----------------------------------------------------------
+    dims = dict(plan.mesh_dims)
+    if plan.fsdp and dims.get(MeshAxis.FSDP, 0) <= 1:
+        # fsdp requested without an explicit size: the fsdp axis absorbs
+        # every device not claimed by other model axes (data stays 1 —
+        # batch is sharded over (data, fsdp) jointly anyway)
+        fixed = 1
+        for axis, size in dims.items():
+            if axis not in (MeshAxis.FSDP, MeshAxis.DATA):
+                fixed *= size
+        if n_devices % fixed == 0 and n_devices // fixed > 1:
+            dims[MeshAxis.FSDP] = n_devices // fixed
+            dims.setdefault(MeshAxis.DATA, 1)
+    spec_kwargs = {axis: size for axis, size in dims.items()
+                   if axis in MeshAxis.ALL}
+    spec = MeshSpec(**spec_kwargs)
+    mesh = create_mesh(spec, context.devices)
+
+    # -- model edits (dataclass-config models) -------------------------
+    updates = {}
+    if plan.compute_dtype is not None:
+        updates["dtype"] = plan.compute_dtype
+    if plan.params_dtype is not None:
+        updates["param_dtype"] = plan.params_dtype
+    if plan.flash_attention:
+        updates["attn_impl"] = (
+            "flash" if jax.default_backend() == "tpu" else "reference")
+    if plan.remat:
+        updates["remat"] = True
+    if updates:
+        if not context.replace_model_config(**updates):
+            logger.info(
+                "model has no dataclass cfg accepting %s; dtype/kernel "
+                "edits skipped (strategy still shapes mesh + shardings)",
+                sorted(updates),
+            )
+
+    # -- sharding rules -------------------------------------------------
+    rules = make_sharding_rules(
+        fsdp=plan.fsdp and mesh.shape[MeshAxis.FSDP] > 1,
+        tensor=plan.tensor_parallel and mesh.shape[MeshAxis.TENSOR] > 1,
+        extra=plan.rule_overrides,
+    )
+
+    # -- batch geometry --------------------------------------------------
+    from dlrover_tpu.parallel.mesh import dp_size as mesh_dp_size
+
+    dp = mesh_dp_size(mesh)
+    if plan.global_batch:
+        accum, micro_global = choose_accumulation(
+            plan.global_batch, dp,
+            max_micro_per_replica=plan.micro_batch or 64)
+        micro = micro_global
+    else:
+        accum = plan.accum_steps
+        micro = plan.micro_batch or dp
+    sample = context.infer_sample_batch(micro)
+
+    if plan.pipeline_stages > 1:
+        raise NotImplementedError(
+            "pipeline lowering arrives with dlrover_tpu.parallel.pipeline; "
+            "use mixed_parallel without pipe for now")
+
+    trainer = build_trainer(
+        context.model,
+        context.make_optimizer(),
+        mesh,
+        np.asarray(sample),
+        context.loss_fn,
+        accum_steps=accum,
+        micro_batch=micro,
+        rules=rules,
+        donate_state=plan.donate_state,
+    )
+    return AccelerateResult(trainer=trainer, mesh=mesh,
+                            model=context.model, strategy=[],
+                            context=context)
+
+
+def auto_accelerate(
+    model: Any,
+    optim_factory: Optional[Callable] = None,
+    dataset: Optional[Any] = None,
+    loss_fn: Optional[Callable] = None,
+    *,
+    sample_batch: Optional[Any] = None,
+    strategy: Optional[Any] = None,
+    load_strategy_file: str = "",
+    save_strategy_to_file: str = "",
+    global_batch: int = 0,
+    micro_batch: int = 0,
+    devices: Optional[Sequence[jax.Device]] = None,
+    optim_args: Optional[dict] = None,
+) -> AccelerateResult:
+    """One-call acceleration (atorch auto_accelerate parity).
+
+    strategy: None → default TPU baseline; "auto" → engine search;
+    list → explicit strategy (names or (name, config) pairs).
+    """
+    context = ModelContext(
+        model, optim_factory=optim_factory, dataset=dataset,
+        loss_fn=loss_fn, sample_batch=sample_batch,
+        optim_args=optim_args, devices=devices,
+    )
+    context.plan.global_batch = global_batch
+    context.plan.micro_batch = micro_batch
+
+    if load_strategy_file:
+        chosen = load_strategy(load_strategy_file)
+    elif strategy == "auto":
+        from dlrover_tpu.auto.engine.acceleration_engine import (
+            search_strategy,
+        )
+
+        chosen = search_strategy(context)
+    elif strategy is not None:
+        chosen = normalize_strategy(strategy)
+    else:
+        chosen = default_strategy(len(context.devices))
+
+    apply_strategy(context, chosen)
+    result = lower(context)
+    result.strategy = chosen
+    if save_strategy_to_file:
+        save_strategy(chosen, save_strategy_to_file)
+    return result

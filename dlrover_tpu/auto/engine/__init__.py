@@ -1,0 +1,7 @@
+"""Strategy-search engine (reference: atorch/auto/engine/)."""
+
+from dlrover_tpu.auto.engine.acceleration_engine import search_strategy
+from dlrover_tpu.auto.engine.analyser import analyse
+from dlrover_tpu.auto.engine.dry_runner import dry_run
+
+__all__ = ["search_strategy", "analyse", "dry_run"]

@@ -1,0 +1,55 @@
+"""Strategy search: candidates → dry-run scores → best strategy.
+
+Capability parity: atorch AccelerationEngine + sg_algo
+(auto/engine/acceleration_engine.py:34, engine/executor.py:36,
+sg_algo/{combination_sg,bo_sg,hebo}). TPU re-design: no worker-process
+gRPC fan-out — candidates are dry-run in-process (strategies change mesh/
+sharding, which jit handles in one process); the search is successive
+halving over the combination space (the BO/HEBO role: sample-efficient
+pruning) with deterministic tie-breaking toward smaller strategies.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.auto.engine.dry_runner import dry_run
+from dlrover_tpu.auto.engine.planner import plan_candidates
+from dlrover_tpu.auto.model_context import ModelContext
+from dlrover_tpu.auto.strategy import Strategy
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def search_strategy(
+    context: ModelContext,
+    max_candidates: int = 0,
+    rungs: Tuple[int, ...] = (1, 3),
+    keep_fraction: float = 0.5,
+) -> Strategy:
+    """Successive halving: profile every candidate briefly (rungs[0]
+    steps), keep the top fraction, re-profile longer, repeat."""
+    max_candidates = max_candidates or int(os.environ.get(
+        "DLROVER_TPU_SEARCH_MAX_CANDIDATES", 8))
+    candidates = plan_candidates(context, max_candidates=max_candidates)
+    if not candidates:
+        return []
+    scored: List[Tuple[float, int, Strategy]] = [
+        (0.0, i, c) for i, c in enumerate(candidates)]
+    for steps in rungs:
+        results = []
+        for _, i, candidate in scored:
+            speed, err = dry_run(context, candidate, warmup=1, steps=steps)
+            results.append((speed, i, candidate))
+            if err:
+                logger.info("candidate %s rejected: %s",
+                            [n for n, _ in candidate], err[:200])
+        results.sort(key=lambda t: (-t[0], len(t[2])))
+        keep = max(1, int(len(results) * keep_fraction))
+        scored = results[:keep]
+        if len(scored) == 1:
+            break
+    best_speed, _, best = scored[0]
+    logger.info("search picked %s (%.2f steps/s)",
+                [name for name, _ in best], best_speed)
+    return best

@@ -1,0 +1,49 @@
+"""Static model analysis feeding strategy pruning.
+
+Capability parity: atorch Analyser (atorch/auto/analyser/analyser.py) —
+model size, dtypes, module inventory — done abstractly with
+`jax.eval_shape` so nothing is materialized.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.auto.model_context import ModelContext
+
+
+def analyse(context: ModelContext, micro_batch: int = 1) -> Dict[str, Any]:
+    sample = np.asarray(context.infer_sample_batch(micro_batch))
+
+    def _init():
+        return context.model.init(jax.random.PRNGKey(0),
+                                  jnp.asarray(sample))
+
+    abstract = jax.eval_shape(_init)
+    leaves = jax.tree.leaves(abstract)
+    param_count = sum(int(np.prod(leaf.shape)) for leaf in leaves)
+    param_bytes = sum(
+        int(np.prod(leaf.shape)) * leaf.dtype.itemsize for leaf in leaves)
+    dtypes = sorted({str(leaf.dtype) for leaf in leaves})
+    # Adam-family training state ≈ params + 2 moments in fp32 + fp32
+    # master copy ⇒ ~16 bytes/param upper bound.
+    train_state_bytes = param_count * 16
+    device = context.devices[0]
+    hbm_bytes = 0
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if stats:
+        hbm_bytes = stats.get("bytes_limit", 0)
+    return {
+        "param_count": param_count,
+        "param_bytes": param_bytes,
+        "param_dtypes": dtypes,
+        "train_state_bytes": train_state_bytes,
+        "device_hbm_bytes": hbm_bytes,
+        "n_devices": len(context.devices),
+        "fits_one_device": (hbm_bytes == 0
+                            or train_state_bytes < hbm_bytes * 0.8),
+    }

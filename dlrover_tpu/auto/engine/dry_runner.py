@@ -1,0 +1,70 @@
+"""Dry-run profiler: score a candidate strategy by actually training.
+
+Capability parity: atorch dry runner (auto/dry_runner/dry_runner.py, used
+at accelerate.py:146-148 with ATORCH_DRYRUN_WARMUP_STEP /
+PROFILE_STEP envs) — lower the strategy, run warmup + profile steps on a
+synthetic batch, return steps/sec. A strategy that fails to lower or OOMs
+scores -inf instead of raising (search must survive bad candidates).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import time
+from typing import Tuple
+
+import jax
+import numpy as np
+
+from dlrover_tpu.auto.model_context import ModelContext
+from dlrover_tpu.auto.strategy import Strategy
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def _fresh_context(context: ModelContext) -> ModelContext:
+    clone = ModelContext(
+        context.model,
+        optim_factory=context.optim_factory,
+        dataset=context.dataset,
+        loss_fn=context.loss_fn,
+        sample_batch=context.sample_batch,
+        optim_args=context.optim_args,
+        devices=context.devices,
+    )
+    clone.plan = copy.deepcopy(context.plan)
+    return clone
+
+
+def dry_run(context: ModelContext, strategy: Strategy,
+            warmup: int = 0, steps: int = 0) -> Tuple[float, str]:
+    """Returns (steps_per_sec, error). error == "" on success."""
+    from dlrover_tpu.auto.accelerate import apply_strategy, lower
+
+    warmup = warmup or int(os.environ.get("DLROVER_TPU_DRYRUN_WARMUP", 1))
+    steps = steps or int(os.environ.get("DLROVER_TPU_DRYRUN_STEPS", 3))
+    try:
+        clone = apply_strategy(_fresh_context(context), strategy)
+        result = lower(clone)
+        trainer = result.trainer
+        state = trainer.init(jax.random.PRNGKey(0))
+        sample = np.asarray(
+            clone.infer_sample_batch(trainer.micro_batch))
+        rng = np.random.default_rng(0)
+        vocab_guess = int(sample.max()) + 2
+        tokens = rng.integers(0, vocab_guess,
+                              (trainer.accum_steps * trainer.micro_batch,)
+                              + sample.shape[1:]).astype(sample.dtype)
+        tok, tgt = trainer.shard_batch(tokens, tokens)
+        for _ in range(max(warmup, 1)):  # ≥1: steps must not time compile
+            state, metrics = trainer.step(state, tok, tgt)
+        jax.block_until_ready(metrics)
+        start = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = trainer.step(state, tok, tgt)
+        jax.block_until_ready(metrics)
+        elapsed = time.perf_counter() - start
+        return steps / max(elapsed, 1e-9), ""
+    except Exception as e:  # noqa: BLE001 - bad candidates must not kill search
+        logger.info("dry run failed for %s: %s", [n for n, _ in strategy], e)
+        return float("-inf"), str(e)

@@ -1,0 +1,51 @@
+"""Planner: prune the optimization space into candidate strategies.
+
+Capability parity: atorch Planner (auto/engine/planner.py:13) — analysis
+gates which optimizations are even considered (distributed passes need >1
+device; fsdp is forced when the train state can't fit one device).
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from typing import List
+
+from dlrover_tpu.auto.engine.analyser import analyse
+from dlrover_tpu.auto.model_context import ModelContext
+from dlrover_tpu.auto.opt_lib import SEMIAUTO_STRATEGIES, OptimizationLibrary
+from dlrover_tpu.auto.strategy import Strategy
+
+
+def plan_candidates(context: ModelContext,
+                    max_candidates: int = 16) -> List[Strategy]:
+    info = analyse(context)
+    opt_lib = OptimizationLibrary()
+    n_devices = info["n_devices"]
+
+    forced: Strategy = []
+    if not info["fits_one_device"] and n_devices > 1:
+        forced.append(("fsdp", {}))
+
+    optional: List[str] = []
+    for name in SEMIAUTO_STRATEGIES:
+        if any(f_name == name for f_name, _ in forced):
+            continue
+        opt = opt_lib[name]
+        if opt.distributed and n_devices < 2:
+            continue
+        if name == "tensor_parallel" and n_devices % 2:
+            continue
+        optional.append(name)
+
+    candidates: List[Strategy] = []
+    # smallest first: baseline (forced only), then singles, then pairs, ...
+    for size in range(0, len(optional) + 1):
+        for combo in combinations(optional, size):
+            if ("fsdp" in combo and "tensor_parallel" in combo
+                    and n_devices < 4):
+                continue
+            strategy = list(forced) + [(name, {}) for name in combo]
+            candidates.append(strategy)
+            if len(candidates) >= max_candidates:
+                return candidates
+    return candidates

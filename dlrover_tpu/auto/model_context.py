@@ -1,0 +1,108 @@
+"""ModelContext + AccelerationPlan.
+
+Capability parity: atorch ModelContext (atorch/auto/model_context.py) —
+carries model/optim/dataset/loss through the optimization passes. The TPU
+difference: passes edit the declarative `AccelerationPlan` (mesh axes,
+sharding-rule table, dtypes, remat, kernels, accumulation) instead of
+wrapping the model; `lower()` compiles the final plan once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class AccelerationPlan:
+    """Everything the final lowering needs, as plain data."""
+
+    # mesh: name → size; data absorbs the remainder when 0
+    mesh_dims: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # logical-axis → mesh-axis overrides appended to the rule table
+    rule_overrides: List[Tuple[str, Optional[str]]] = dataclasses.field(
+        default_factory=list)
+    fsdp: bool = False
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
+    expert_parallel: bool = False
+    pipeline_stages: int = 1
+    compute_dtype: Optional[Any] = None      # jnp.bfloat16 for half/amp
+    params_dtype: Optional[Any] = None       # fp32 master params when amp
+    remat: bool = False
+    remat_policy: str = ""                   # "" | "full" | "dots" | "nothing_saveable"
+    flash_attention: bool = False
+    accum_steps: int = 1
+    micro_batch: int = 0                     # 0 = derive from global batch
+    global_batch: int = 0
+    donate_state: bool = True
+    extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class ModelContext:
+    """Mutable carrier through optimization passes."""
+
+    def __init__(
+        self,
+        model: Any,
+        optim_factory: Optional[Callable[..., Any]] = None,
+        dataset: Optional[Any] = None,
+        loss_fn: Optional[Callable] = None,
+        sample_batch: Optional[Any] = None,
+        optim_args: Optional[dict] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        self.model = model
+        self.optim_factory = optim_factory
+        self.optim_args = dict(optim_args or {})
+        self.dataset = dataset
+        self.loss_fn = loss_fn
+        self.sample_batch = sample_batch
+        self.devices = list(devices if devices is not None
+                            else jax.devices())
+        self.plan = AccelerationPlan()
+        # wrappers applied to the model's apply fn at lowering (in order)
+        self.apply_transforms: List[Callable] = []
+
+    # -- model-config editing (models expose a dataclass config) ---------
+    def model_config(self):
+        for attr in ("config", "cfg"):
+            cfg = getattr(self.model, attr, None)
+            if cfg is not None and dataclasses.is_dataclass(cfg):
+                return cfg
+        return None
+
+    def replace_model_config(self, **updates) -> bool:
+        """For framework models (dataclass cfg): rebuild with new config.
+        Returns False when the model doesn't expose a compatible cfg."""
+        cfg = self.model_config()
+        if cfg is None or not dataclasses.is_dataclass(cfg):
+            return False
+        valid = {f.name for f in dataclasses.fields(cfg)}
+        usable = {k: v for k, v in updates.items() if k in valid}
+        if len(usable) != len(updates):
+            return False
+        new_cfg = dataclasses.replace(cfg, **usable)
+        self.model = type(self.model)(new_cfg)
+        return True
+
+    def make_optimizer(self):
+        import optax
+
+        if self.optim_factory is None:
+            return optax.adamw(3e-4)
+        return self.optim_factory(**self.optim_args)
+
+    def infer_sample_batch(self, micro_batch: int):
+        """A (micro_batch, seq)-shaped sample for shape inference."""
+        if self.sample_batch is not None:
+            sample = np.asarray(self.sample_batch)
+            if sample.shape[0] != micro_batch:
+                reps = int(np.ceil(micro_batch / sample.shape[0]))
+                sample = np.tile(sample, (reps,) + (1,) * (sample.ndim - 1))
+                sample = sample[:micro_batch]
+            return sample
+        raise ValueError("sample_batch is required for lowering")

@@ -1,0 +1,256 @@
+"""The optimization registry and every built-in pass."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+from dlrover_tpu.auto.model_context import ModelContext
+from dlrover_tpu.common.constants import MeshAxis
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class Optimization:
+    """One named pass editing the plan. `distributed` passes need >1
+    device to be meaningful; the planner uses this for pruning."""
+
+    name: str = ""
+    distributed: bool = False
+    # mutually-exclusive group (e.g. only one of zero1/zero2/fsdp)
+    group: str = ""
+
+    def apply(self, context: ModelContext, config: dict) -> None:
+        raise NotImplementedError
+
+
+def _set_mesh_dim(context: ModelContext, axis: str, size: int) -> None:
+    context.plan.mesh_dims[axis] = size
+
+
+class ParallelModeOptimization(Optimization):
+    """Pure data parallelism (DDP analog). config: {"data": N} or empty
+    (data absorbs all devices)."""
+
+    name = "parallel_mode"
+    distributed = True
+
+    def apply(self, context, config):
+        if "data" in config:
+            _set_mesh_dim(context, MeshAxis.DATA, int(config["data"]))
+
+
+class Zero1Optimization(Optimization):
+    """Optimizer-state sharding. On TPU the fsdp axis shards params AND
+    optimizer state (XLA re-gathers weights as needed); zero1/zero2/fsdp
+    differ only in how much of the rule table they move to the fsdp axis —
+    kept as separate names for strategy parity."""
+
+    name = "zero1"
+    distributed = True
+    group = "zero"
+
+    def apply(self, context, config):
+        context.plan.fsdp = True
+        size = int(config.get("size", 0))
+        if size:
+            _set_mesh_dim(context, MeshAxis.FSDP, size)
+
+
+class Zero2Optimization(Zero1Optimization):
+    name = "zero2"
+
+
+class FSDPOptimization(Zero1Optimization):
+    name = "fsdp"
+
+
+class AmpOptimization(Optimization):
+    """bf16 compute with fp32 master params (native-AMP analog — TPUs use
+    bf16, no loss scaling needed: bf16 has fp32's exponent range)."""
+
+    name = "amp"
+
+    def apply(self, context, config):
+        context.plan.compute_dtype = jnp.bfloat16
+        context.plan.params_dtype = jnp.float32
+
+
+class HalfOptimization(Optimization):
+    """Everything in bf16 (atorch half 'bf16')."""
+
+    name = "half"
+
+    def apply(self, context, config):
+        dtype = config.get("dtype", "bfloat16")
+        context.plan.compute_dtype = jnp.dtype(dtype)
+        context.plan.params_dtype = jnp.dtype(dtype)
+
+
+class RematOptimization(Optimization):
+    """Activation checkpointing via jax.checkpoint (atorch 'checkpoint')."""
+
+    name = "checkpoint"
+
+    def apply(self, context, config):
+        context.plan.remat = True
+        context.plan.remat_policy = config.get("policy", "full")
+
+
+class ModuleReplaceOptimization(Optimization):
+    """Swap attention for the Pallas flash kernel (atorch module_replace
+    pairs BertAttention→FlashAttn etc.)."""
+
+    name = "module_replace"
+
+    def apply(self, context, config):
+        context.plan.flash_attention = True
+
+
+class TensorParallelOptimization(Optimization):
+    """Megatron-style TP: column/row splits come from the logical-axis rule
+    table, no module surgery. config: {"size": N}."""
+
+    name = "tensor_parallel"
+    distributed = True
+
+    def apply(self, context, config):
+        context.plan.tensor_parallel = True
+        _set_mesh_dim(context, MeshAxis.TENSOR,
+                      int(config.get("size", 2)))
+
+
+class SequenceParallelOptimization(Optimization):
+    """Ring attention over a sequence axis (atorch
+    DistributedSelfAttention analog). config: {"size": N}."""
+
+    name = "sequence_parallel"
+    distributed = True
+
+    def apply(self, context, config):
+        context.plan.sequence_parallel = True
+        _set_mesh_dim(context, MeshAxis.SEQUENCE,
+                      int(config.get("size", 2)))
+
+
+class ExpertParallelOptimization(Optimization):
+    """MoE expert-parallel axis. config: {"size": N}."""
+
+    name = "expert_parallel"
+    distributed = True
+
+    def apply(self, context, config):
+        context.plan.expert_parallel = True
+        _set_mesh_dim(context, MeshAxis.EXPERT,
+                      int(config.get("size", 2)))
+
+
+class PipelineParallelOptimization(Optimization):
+    """Stage-sharded pipeline over the pipe axis. config: {"size": N}."""
+
+    name = "pipeline_parallel"
+    distributed = True
+
+    def apply(self, context, config):
+        size = int(config.get("size", 2))
+        context.plan.pipeline_stages = size
+        _set_mesh_dim(context, MeshAxis.PIPE, size)
+
+
+class MixedParallelOptimization(Optimization):
+    """Arbitrary named dims: config {"dims": [["tensor",4],["data",2]]}
+    (atorch create_parallel_group spec,
+    atorch/distributed/distributed.py:323-334)."""
+
+    name = "mixed_parallel"
+    distributed = True
+
+    def apply(self, context, config):
+        for name, size in config.get("dims", []):
+            _set_mesh_dim(context, name, int(size))
+            if name == MeshAxis.FSDP:
+                context.plan.fsdp = True
+            elif name == MeshAxis.TENSOR:
+                context.plan.tensor_parallel = True
+            elif name == MeshAxis.SEQUENCE:
+                context.plan.sequence_parallel = True
+            elif name == MeshAxis.EXPERT:
+                context.plan.expert_parallel = True
+            elif name == MeshAxis.PIPE:
+                context.plan.pipeline_stages = int(size)
+
+
+class ThreeDParallelOptimization(Optimization):
+    """data×tensor×pipe preset (DeepSpeed 3D analog). config:
+    {"data": D, "tensor": T, "pipe": P}."""
+
+    name = "3d_parallel"
+    distributed = True
+
+    def apply(self, context, config):
+        MixedParallelOptimization().apply(context, {"dims": [
+            [MeshAxis.DATA, config.get("data", 1)],
+            [MeshAxis.TENSOR, config.get("tensor", 2)],
+            [MeshAxis.PIPE, config.get("pipe", 2)],
+        ]})
+
+
+class OptimizationLibrary:
+    """Name → Optimization registry (atorch
+    OptimizationLibrary.register_optimizations)."""
+
+    def __init__(self):
+        self.opts: Dict[str, Optimization] = {}
+        for opt_cls in (
+            ParallelModeOptimization,
+            Zero1Optimization,
+            Zero2Optimization,
+            FSDPOptimization,
+            AmpOptimization,
+            HalfOptimization,
+            RematOptimization,
+            ModuleReplaceOptimization,
+            TensorParallelOptimization,
+            SequenceParallelOptimization,
+            ExpertParallelOptimization,
+            PipelineParallelOptimization,
+            MixedParallelOptimization,
+            ThreeDParallelOptimization,
+        ):
+            opt = opt_cls()
+            self.opts[opt.name] = opt
+        # atorch aliases
+        self.opts["remat"] = self.opts["checkpoint"]
+        self.opts["amp_native"] = self.opts["amp"]
+
+    def __getitem__(self, name: str) -> Optimization:
+        return self.opts[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.opts
+
+    def validate_strategy(self, strategy) -> None:
+        seen_groups: Dict[str, str] = {}
+        for name, _ in strategy:
+            if name not in self.opts:
+                raise ValueError(
+                    f"unknown optimization {name!r}; "
+                    f"available: {sorted(self.opts)}")
+            group = self.opts[name].group
+            if group:
+                if group in seen_groups:
+                    raise ValueError(
+                        f"optimizations {seen_groups[group]!r} and "
+                        f"{name!r} are mutually exclusive")
+                seen_groups[group] = name
+
+
+# Strategies the semi-auto mode will combine and dry-run (atorch
+# SEMIAUTO_STRATEGIES, optimization_library.py:13).
+SEMIAUTO_STRATEGIES = (
+    "amp",
+    "checkpoint",
+    "module_replace",
+    "fsdp",
+    "tensor_parallel",
+)

@@ -1,0 +1,38 @@
+"""Strategy model: a named list of optimizations with configs.
+
+Capability parity: atorch strategy save/load
+(auto_accelerate(load_strategy=..., save_strategy_to_file=...),
+atorch/auto/accelerate.py:408) — JSON on disk, `[(name, config), ...]` in
+memory.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Tuple
+
+Strategy = List[Tuple[str, Dict[str, Any]]]
+
+
+def normalize_strategy(strategy) -> Strategy:
+    """Accept ["fsdp", ("amp", {...})] shorthand."""
+    out: Strategy = []
+    for item in strategy:
+        if isinstance(item, str):
+            out.append((item, {}))
+        else:
+            name, config = item
+            out.append((name, dict(config or {})))
+    return out
+
+
+def save_strategy(strategy: Strategy, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump([[name, config] for name, config in strategy], f,
+                  indent=2)
+
+
+def load_strategy(path: str) -> Strategy:
+    with open(path) as f:
+        raw = json.load(f)
+    return [(name, dict(config)) for name, config in raw]

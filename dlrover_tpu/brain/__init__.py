@@ -1,0 +1,16 @@
+"""Brain: cluster-wide resource optimization from historical job metrics.
+
+Capability parity: dlrover/go/brain/ (gRPC Brain service — persist_metrics,
+optimize, get_job_metrics; dlrover/proto/brain.proto:196-200; MySQL
+datastore; pluggable optimizer algorithms in
+pkg/optimizer/implementation/optalgorithm/). TPU-native re-design: the
+same 3 operations over this framework's 2-RPC comm layer, a sqlite
+datastore (stdlib, zero-dep), and algorithms re-framed for TPU jobs (host
+shapes + chip counts instead of PS CPU). Only consulted when
+optimizeMode == "cluster"; single-job mode never needs it.
+"""
+
+from dlrover_tpu.brain.client import BrainClient, BrainReporter
+from dlrover_tpu.brain.service import BrainService
+
+__all__ = ["BrainClient", "BrainReporter", "BrainService"]

@@ -392,3 +392,47 @@ class ClusterVersionRequest(Message):
 @dataclass
 class ClusterVersion(Message):
     version: int = 0
+
+
+# --------------------------------------------------------------------------
+# Brain service (reference: dlrover/proto/brain.proto persist_metrics /
+# optimize / get_job_metrics; dlrover/python/brain/client.py)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class BrainMetricsReport(Message):
+    """persist_metrics: one record of job runtime/meta/model metrics."""
+
+    job_name: str = ""
+    job_uuid: str = ""
+    record_type: str = ""        # "job_meta" | "runtime" | "model" | "job_exit"
+    payload_json: str = ""
+
+
+@dataclass
+class BrainOptimizeRequest(Message):
+    """optimize: ask for a resource plan at a given stage."""
+
+    job_name: str = ""
+    stage: str = ""              # OptimizeStage.*
+    config_json: str = ""
+
+
+@dataclass
+class BrainResourcePlan(Message):
+    plan_json: str = ""          # {"node_group_resources": {type: {...}}}
+    found: bool = False
+
+
+@dataclass
+class BrainJobMetricsRequest(Message):
+    """get_job_metrics: fetch persisted records of a job."""
+
+    job_name: str = ""
+    record_type: str = ""
+
+
+@dataclass
+class BrainJobMetrics(Message):
+    records_json: str = ""       # JSON list

@@ -43,6 +43,7 @@ class JobMaster:
         job_args=None,
         cluster=None,
         host: str = "0.0.0.0",
+        brain_addr: str = "",
     ):
         ctx = Context.singleton()
         params = RendezvousParameters(
@@ -83,6 +84,8 @@ class JobMaster:
         )
         self._stopped = threading.Event()
         self._exit_reason = ""
+        self.metric_collector = None
+        self.auto_scaler = None
         if job_manager is None and job_args is not None:
             from dlrover_tpu.master.node.event_callback import (
                 RendezvousMembershipCallback,
@@ -100,12 +103,69 @@ class JobMaster:
                                              self.speed_monitor))
             self.job_manager = manager
             self.servicer.job_manager = manager
+            self._attach_optimization(job_args, brain_addr)
+
+    def _attach_optimization(self, job_args, brain_addr: str) -> None:
+        """Wire stats collection + resource optimization + auto-scaling
+        (reference: dist_master.py:116-127 reporter selection and the
+        JobResourceOptimizer/JobAutoScaler composition)."""
+        from dlrover_tpu.common.constants import OptimizeMode
+        from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+        from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+        from dlrover_tpu.master.stats.reporter import (
+            ReporterType,
+            StatsReporter,
+        )
+
+        use_brain = (job_args.optimize_mode == OptimizeMode.CLUSTER
+                     and brain_addr)
+        if use_brain:
+            from dlrover_tpu.brain.client import BrainResourceOptimizer
+
+            reporter = StatsReporter.new_reporter(
+                ReporterType.BRAIN, addr=brain_addr,
+                job_name=job_args.job_name, job_uuid=job_args.job_uuid)
+            optimizer = BrainResourceOptimizer(brain_addr,
+                                               job_args.job_name)
+        else:
+            from dlrover_tpu.master.resource.local_optimizer import (
+                LocalResourceOptimizer,
+            )
+
+            reporter = StatsReporter.new_reporter(ReporterType.LOCAL)
+            optimizer = LocalResourceOptimizer()
+        self.metric_collector = JobMetricCollector(
+            job_args.job_name, reporter, stats=optimizer.stats)
+        self.metric_collector.attach(speed_monitor=self.speed_monitor,
+                                     job_manager=self.job_manager)
+        self.servicer.metric_collector = self.metric_collector
+        worker_args = job_args.worker_args()
+        if worker_args is not None:
+            resource = worker_args.group_resource.node_resource
+            self.metric_collector.report_job_meta(
+                worker_count=worker_args.group_resource.count,
+                cpu=resource.cpu, memory_mb=resource.memory_mb,
+                chips=resource.chips, chip_type=resource.chip_type,
+                distribution_strategy=job_args.distribution_strategy,
+            )
+        if job_args.optimize_mode != OptimizeMode.MANUAL:
+            self.auto_scaler = JobAutoScaler(
+                self.job_manager, optimizer,
+                speed_monitor=self.speed_monitor,
+                interval_s=Context.singleton().seconds_per_scale_check,
+            )
+            self.auto_scaler.paral_config_sink = (
+                self.servicer.merge_paral_config)
 
     # ------------------------------------------------------------------
     def prepare(self) -> None:
         self._server.start()
         if self.job_manager is not None:
             self.job_manager.start()
+        if self.metric_collector is not None:
+            self.metric_collector.start()
+        if self.auto_scaler is not None:
+            self.auto_scaler.start()
         self.task_manager.start_timeout_recovery()
         logger.info("job master serving on port %d", self.port)
 
@@ -147,6 +207,14 @@ class JobMaster:
     def stop(self, grace_s: float = 1.0) -> None:
         if not self._stopped.is_set():
             self._stopped.set()
+            if self.metric_collector is not None:
+                stage = (self.job_manager.job_stage()
+                         if self.job_manager else "")
+                self.metric_collector.report_job_exit(stage,
+                                                      self._exit_reason)
+                self.metric_collector.stop()
+            if self.auto_scaler is not None:
+                self.auto_scaler.stop()
             if self.job_manager is not None:
                 self.job_manager.stop()
             self._server.stop(grace_s)

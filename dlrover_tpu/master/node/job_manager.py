@@ -183,6 +183,11 @@ class JobManager:
             logger.warning("node %s exhausted relaunch budget (%d)",
                            node.name, node.max_relaunch_count)
             return False
+        args = self._job_args.node_args.get(node.type)
+        if args is not None and node.rank_index >= args.group_resource.count:
+            # rank beyond the current target group size: this deletion is a
+            # deliberate scale-down, not a failure
+            return False
         return True
 
     def _relaunch_node(self, node: Node) -> None:
@@ -292,17 +297,37 @@ class JobManager:
     def handle_scale_request(self, request: msg.ScaleRequest) -> None:
         """Manual scale (reference: ScalePlanReconciler relay +
         handle in master)."""
-        plan = ScalePlan()
-        with self._lock:
-            args = self._job_args.node_args.get(request.node_type)
-            if args is None:
-                return
-            resource = args.group_resource.node_resource
-            args.group_resource.count = request.count
-        plan.node_group_resources[request.node_type] = NodeGroupResource(
-            count=request.count, node_resource=resource)
         logger.info("manual scale: %s -> %d", request.node_type,
                     request.count)
+        self.scale_node_group(request.node_type, request.count)
+
+    def scale_node_group(self, node_type: str, count: int,
+                         resource=None) -> None:
+        """Resize a node group. Shrinks remove explicit top-rank victims
+        marked released so their deletion events are not mistaken for
+        failures and relaunched."""
+        with self._lock:
+            args = self._job_args.node_args.get(node_type)
+            if args is None:
+                return
+            resource = resource or args.group_resource.node_resource
+            args.group_resource.count = count
+            alive = sorted(
+                (n for n in self._nodes.get(node_type, {}).values()
+                 if n.is_alive() and not n.is_released),
+                key=lambda n: n.rank_index,
+            )
+        plan = ScalePlan()
+        if count < len(alive):
+            victims = alive[count:]
+            for node in victims:
+                node.relaunchable = False
+                node.is_released = True
+            plan.remove_nodes.extend(victims)
+        # group resize both grows and catches pods the manager hasn't
+        # adopted yet (the scaler trims to the target after removals)
+        plan.node_group_resources[node_type] = NodeGroupResource(
+            count=count, node_resource=resource)
         self._scaler.scale(plan)
 
     def collect_model_info(self, info: msg.ModelInfo) -> None:

@@ -1,0 +1,15 @@
+"""Resource plans, optimizers, auto-scaling (reference: dlrover/python/master/resource/)."""
+
+from dlrover_tpu.master.resource.optimizer import (
+    ResourceLimits,
+    ResourceOptimizer,
+    ResourcePlan,
+)
+from dlrover_tpu.master.resource.local_optimizer import LocalResourceOptimizer
+
+__all__ = [
+    "ResourceLimits",
+    "ResourceOptimizer",
+    "ResourcePlan",
+    "LocalResourceOptimizer",
+]

@@ -8,6 +8,7 @@ Scaler actuates it against the platform.
 from __future__ import annotations
 
 import abc
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List
 
@@ -39,6 +40,8 @@ class Scaler(abc.ABC):
 
     def __init__(self, job_name: str):
         self.job_name = job_name
+        self._id_lock = threading.Lock()
+        self._next_id: Dict[str, int] = {}
 
     @abc.abstractmethod
     def scale(self, plan: ScalePlan) -> None:
@@ -49,3 +52,32 @@ class Scaler(abc.ABC):
 
     def stop(self) -> None:  # pragma: no cover - default no-op
         pass
+
+    # -- node-id allocation (shared by all backends) --------------------
+    def alloc_id(self, node_type: str) -> int:
+        with self._id_lock:
+            next_id = self._next_id.get(node_type, 0)
+            self._next_id[node_type] = next_id + 1
+            return next_id
+
+    def register_existing(self, node_type: str, upto_id: int) -> None:
+        """Keep the allocator ahead of externally-assigned ids (manager
+        relaunch ids) so a group-grow never reuses a live pod name."""
+        with self._id_lock:
+            self._next_id[node_type] = max(
+                self._next_id.get(node_type, 0), upto_id)
+
+    @staticmethod
+    def fill_rank_holes(used_ranks, count: int, needed: int) -> List[int]:
+        """Ranks for `needed` new nodes: lowest free ranks below `count`
+        first (a relaunched node keeps its rank, so grows must fill the
+        holes), then sequential past the end."""
+        used = set(used_ranks)
+        free = [r for r in range(count) if r not in used]
+        ranks = free[:needed]
+        rank = count
+        while len(ranks) < needed:
+            if rank not in used:
+                ranks.append(rank)
+            rank += 1
+        return ranks

@@ -7,8 +7,7 @@ injects into containers.
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, Optional
+from typing import Optional
 
 from dlrover_tpu.common.constants import NodeEnv, NodeStatus
 from dlrover_tpu.common.log import default_logger as logger
@@ -23,22 +22,9 @@ class LocalScaler(Scaler):
         super().__init__(job_name)
         self._cluster = cluster
         self._master_addr = master_addr
-        self._lock = threading.Lock()
-        # max node id handed out per type, for group-size launches
-        self._next_id: Dict[str, int] = {}
-
-    def _alloc_id(self, node_type: str) -> int:
-        with self._lock:
-            next_id = self._next_id.get(node_type, 0)
-            self._next_id[node_type] = next_id + 1
-            return next_id
-
-    def register_existing(self, node_type: str, upto_id: int) -> None:
-        with self._lock:
-            self._next_id[node_type] = max(
-                self._next_id.get(node_type, 0), upto_id)
 
     def _create(self, node: Node, node_num: int) -> None:
+        self.register_existing(node.type, node.id + 1)
         pod = PodRecord(
             name=node.name,
             node_type=node.type,
@@ -69,9 +55,11 @@ class LocalScaler(Scaler):
             group_total = group.count
             delta = group.count - len(existing)
             if delta > 0:
-                for _ in range(delta):
-                    node_id = self._alloc_id(node_type)
-                    node = Node(node_type, node_id,
+                ranks = self.fill_rank_holes(
+                    (p.rank_index for p in existing), group.count, delta)
+                for rank in ranks:
+                    node = Node(node_type, self.alloc_id(node_type),
+                                rank_index=rank,
                                 config_resource=group.node_resource)
                     self._create(node, group.count)
             elif delta < 0:

@@ -45,8 +45,6 @@ class PodScaler(Scaler):
         self._owner_ref = owner_ref
         self._retry_interval_s = retry_interval_s
         self._create_queue: "queue.Queue[Node]" = queue.Queue()
-        self._next_id: Dict[str, int] = {}
-        self._lock = threading.Lock()
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._node_num: Dict[str, int] = {}
@@ -59,12 +57,6 @@ class PodScaler(Scaler):
 
     def stop(self) -> None:
         self._stopped.set()
-
-    def _alloc_id(self, node_type: str) -> int:
-        with self._lock:
-            next_id = self._next_id.get(node_type, 0)
-            self._next_id[node_type] = next_id + 1
-            return next_id
 
     def _periodic_create_pod(self) -> None:
         """Drain the creation queue; failed creates are re-queued
@@ -108,8 +100,11 @@ class PodScaler(Scaler):
                     live.append(fields)
             delta = group.count - len(live)
             if delta > 0:
-                for _ in range(delta):
-                    node = Node(node_type, self._alloc_id(node_type),
+                ranks = self.fill_rank_holes(
+                    (f["rank_index"] for f in live), group.count, delta)
+                for rank in ranks:
+                    node = Node(node_type, self.alloc_id(node_type),
+                                rank_index=rank,
                                 config_resource=group.node_resource)
                     self._create_queue.put(node)
             elif delta < 0:
@@ -117,4 +112,5 @@ class PodScaler(Scaler):
                         live, key=lambda f: -f["rank_index"])[:(-delta)]:
                     self._client.delete_pod(fields["name"])
         for node in plan.launch_nodes:
+            self.register_existing(node.type, node.id + 1)
             self._create_queue.put(node)

@@ -39,6 +39,7 @@ class MasterServicer:
         sync_service: Optional[SyncService] = None,
         elastic_ps_service: Optional[ElasticPsService] = None,
         job_manager=None,
+        metric_collector=None,
     ):
         self.task_manager = task_manager or TaskManager()
         self.rdzv_managers: Dict[str, RendezvousManager] = rdzv_managers or {
@@ -50,6 +51,7 @@ class MasterServicer:
         self.sync_service = sync_service or SyncService()
         self.elastic_ps_service = elastic_ps_service or ElasticPsService()
         self.job_manager = job_manager  # optional: node lifecycle owner
+        self.metric_collector = metric_collector  # optional: stats sink
         self._paral_config = msg.ParallelConfig()
         self._start_time = time.time()
 
@@ -171,6 +173,8 @@ class MasterServicer:
         elif isinstance(request, msg.NodeResourceStats):
             if self.job_manager is not None:
                 self.job_manager.update_node_resource_usage(request)
+            if self.metric_collector is not None:
+                self.metric_collector.collect_node_stats(request)
         elif isinstance(request, msg.NodeHeartbeat):
             if self.job_manager is not None:
                 self.job_manager.collect_heartbeat(request.node_id,
@@ -207,6 +211,8 @@ class MasterServicer:
         elif isinstance(request, msg.ModelInfo):
             if self.job_manager is not None:
                 self.job_manager.collect_model_info(request)
+            if self.metric_collector is not None:
+                self.metric_collector.collect_model_info(request)
         else:
             logger.warning("report: unknown request %s",
                            type(request).__name__)
@@ -225,3 +231,15 @@ class MasterServicer:
 
     def update_paral_config(self, config: msg.ParallelConfig) -> None:
         self._paral_config = config
+
+    def merge_paral_config(self, **fields) -> msg.ParallelConfig:
+        """Merge tuned knobs into the current config, bumping its version
+        (partial updates must not clobber other tuned fields or publish a
+        stale version number)."""
+        import dataclasses
+
+        current = self._paral_config
+        self._paral_config = dataclasses.replace(
+            current, version=current.version + 1,
+            **{k: v for k, v in fields.items() if v})
+        return self._paral_config

@@ -183,6 +183,27 @@ class TestJobManagerLifecycle:
         assert wait_until(lambda: manager.job_stage() == JobStage.SUCCEEDED)
         manager.stop()
 
+    def test_grow_after_relaunch_fills_rank_holes(self):
+        # relaunch keeps rank; a later grow must fill the free rank, not
+        # mint rank == count (which rendezvous would reject)
+        cluster, manager = start_manager(workers=3)
+        victim = [p for p in cluster.list_pods(NodeType.WORKER)
+                  if p.rank_index == 1][0]
+        cluster.fail_pod(victim.name, NodeExitReason.UNKNOWN_ERROR)
+        assert wait_until(
+            lambda: len(manager.get_running_workers()) == 3)
+        from dlrover_tpu.common import messages as msg
+
+        manager.handle_scale_request(
+            msg.ScaleRequest(node_type=NodeType.WORKER, count=5))
+        assert wait_until(
+            lambda: len(manager.get_running_workers()) == 5)
+        ranks = sorted(p.rank_index
+                       for p in cluster.list_pods(NodeType.WORKER)
+                       if p.status == NodeStatus.RUNNING)
+        assert ranks == [0, 1, 2, 3, 4]
+        manager.stop()
+
     def test_manual_scale_request(self):
         from dlrover_tpu.common import messages as msg
 

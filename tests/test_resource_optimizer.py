@@ -1,0 +1,261 @@
+"""Resource optimizer / auto-scaler / stats / brain tests.
+
+Mirrors the reference's test_local_optimizer.py, test_job_auto_scaler.py,
+and the brain optalgorithm table tests — all in-memory or over loopback.
+"""
+
+import time
+
+from dlrover_tpu.brain.algorithms import (
+    optimize_job_create_resource,
+    optimize_job_oom_resource,
+)
+from dlrover_tpu.brain.datastore import MetricsStore
+from dlrover_tpu.brain.service import BrainService
+from dlrover_tpu.brain.client import BrainClient, BrainResourceOptimizer
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.master.resource.local_optimizer import (
+    LocalResourceOptimizer,
+)
+from dlrover_tpu.master.resource.optimizer import (
+    OptimizeStage,
+    ResourceLimits,
+)
+from dlrover_tpu.master.resource.stats_collector import (
+    NodeSample,
+    RuntimeStatsCollector,
+)
+
+
+def _sample(cpu=50.0, mem=4096.0, duty=80.0):
+    return NodeSample(timestamp=time.time(), cpu_percent=cpu,
+                      memory_mb=mem, chip_duty_cycle_pct=duty)
+
+
+class TestLocalOptimizer:
+    def test_job_create_plan_from_config(self):
+        opt = LocalResourceOptimizer()
+        plan = opt.generate_plan(OptimizeStage.JOB_CREATE,
+                                 {"worker_count": 4, "chips": 8})
+        group = plan.node_group_resources[NodeType.WORKER]
+        assert group.count == 4
+        assert group.node_resource.chips == 8
+
+    def test_node_initial_right_sizes_memory(self):
+        stats = RuntimeStatsCollector()
+        stats.add_node_sample(NodeType.WORKER, 0, _sample(mem=10000))
+        stats.add_node_sample(NodeType.WORKER, 1, _sample(mem=12000))
+        opt = LocalResourceOptimizer(stats)
+        plan = opt.generate_plan(OptimizeStage.NODE_INITIAL, {})
+        resource = plan.node_group_resources[NodeType.WORKER].node_resource
+        assert resource.memory_mb == 12000 * 1.4
+
+    def test_running_grows_workers_when_unobserved(self):
+        stats = RuntimeStatsCollector()
+        stats.add_speed_sample(2, 10.0)
+        opt = LocalResourceOptimizer(stats)
+        plan = opt.generate_plan(
+            OptimizeStage.RUNNING,
+            {"worker_count": 2, "max_worker_count": 4})
+        assert plan.node_group_resources[NodeType.WORKER].count == 3
+
+    def test_running_respects_scaling_efficiency(self):
+        stats = RuntimeStatsCollector()
+        for _ in range(3):
+            stats.add_speed_sample(2, 10.0)
+            stats.add_speed_sample(3, 10.4)  # barely faster: don't grow
+        opt = LocalResourceOptimizer(stats)
+        plan = opt.generate_plan(
+            OptimizeStage.RUNNING,
+            {"worker_count": 2, "max_worker_count": 4})
+        assert plan.empty()
+
+    def test_zero_speed_never_shrinks(self):
+        # startup/compilation shows speed 0: that is "no data", not a
+        # shrink signal
+        stats = RuntimeStatsCollector()
+        stats.add_speed_sample(8, 0.0)
+        opt = LocalResourceOptimizer(stats)
+        plan = opt.generate_plan(
+            OptimizeStage.RUNNING,
+            {"worker_count": 8, "max_worker_count": 16})
+        assert plan.empty()
+
+    def test_failed_growth_shrinks_back_and_is_not_retried(self):
+        stats = RuntimeStatsCollector()
+        stats.add_speed_sample(2, 10.0)
+        stats.add_speed_sample(3, 10.2)  # growth didn't pay off
+        opt = LocalResourceOptimizer(stats)
+        plan = opt.generate_plan(
+            OptimizeStage.RUNNING,
+            {"worker_count": 3, "max_worker_count": 4})
+        assert plan.node_group_resources[NodeType.WORKER].count == 2
+        # back at 2, the rejected count 3 is not explored again
+        plan = opt.generate_plan(
+            OptimizeStage.RUNNING,
+            {"worker_count": 2, "max_worker_count": 4})
+        assert plan.empty()
+
+    def test_hot_host_suggests_dataloader_workers(self):
+        stats = RuntimeStatsCollector()
+        stats.add_node_sample(NodeType.WORKER, 0,
+                              _sample(cpu=95.0, duty=20.0))
+        stats.add_speed_sample(1, 5.0)
+        opt = LocalResourceOptimizer(stats)
+        plan = opt.generate_plan(
+            OptimizeStage.RUNNING, {"worker_count": 1,
+                                    "max_worker_count": 1})
+        assert plan.dataloader_workers == 2
+
+    def test_oom_recovery_bumps_memory(self):
+        opt = LocalResourceOptimizer()
+        plan = opt.generate_oom_recovery_plan(NodeType.WORKER, 8192)
+        resource = plan.node_group_resources[NodeType.WORKER].node_resource
+        assert resource.memory_mb == 8192 * 1.5
+
+    def test_limits_cap_plan(self):
+        opt = LocalResourceOptimizer()
+        plan = opt.generate_plan(OptimizeStage.JOB_CREATE,
+                                 {"worker_count": 100, "memory_mb": 999999})
+        plan.limit(ResourceLimits(max_nodes=8, max_memory_mb=32768))
+        group = plan.node_group_resources[NodeType.WORKER]
+        assert group.count == 8
+        assert group.node_resource.memory_mb == 32768
+
+
+class TestAutoScaler:
+    def test_scaler_executes_growth_plan(self):
+        import tests.test_job_manager as tj
+        from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+        from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+        cluster, manager = tj.start_manager(workers=2)
+        args = manager.job_args.worker_args()
+        args.max_count = 4
+        stats = RuntimeStatsCollector()
+        stats.add_speed_sample(2, 10.0)
+        optimizer = LocalResourceOptimizer(stats)
+        scaler = JobAutoScaler(manager, optimizer,
+                               speed_monitor=SpeedMonitor(),
+                               interval_s=3600)
+        plan = scaler.execute_job_optimization()
+        assert plan is not None
+        assert tj.wait_until(
+            lambda: len(manager.get_running_workers()) == 3)
+        manager.stop()
+
+
+class TestAutoScalerParalConfig:
+    def test_hot_host_config_reaches_servicer(self):
+        import tests.test_job_manager as tj
+        from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+        from dlrover_tpu.master.servicer import MasterServicer
+        from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+        cluster, manager = tj.start_manager(workers=1)
+        stats = RuntimeStatsCollector()
+        stats.add_node_sample(NodeType.WORKER, 0,
+                              _sample(cpu=95.0, duty=20.0))
+        optimizer = LocalResourceOptimizer(stats)
+        servicer = MasterServicer()
+        scaler = JobAutoScaler(manager, optimizer,
+                               speed_monitor=SpeedMonitor(),
+                               interval_s=3600)
+        scaler.paral_config_sink = servicer.merge_paral_config
+        from dlrover_tpu.common import messages as msg
+
+        # pre-existing tuned fields must survive the hot-host merge
+        servicer.update_paral_config(
+            msg.ParallelConfig(dataloader_batch_size=64, version=5))
+        scaler.execute_job_optimization()
+        config = servicer.get(msg.ParallelConfigRequest())
+        assert config.dataloader_workers == 2
+        assert config.dataloader_batch_size == 64
+        assert config.version == 6
+        manager.stop()
+
+
+class TestBrain:
+    def _seed_history(self, store, job="old", count=6, chips=4):
+        store.persist(job, "job_meta", {"worker_count": count, "cpu": 8,
+                                        "memory_mb": 16384, "chips": chips})
+        store.persist(job, "model", {"param_count": 7e9})
+        store.persist(job, "job_exit", {"stage": "succeeded"})
+
+    def test_cold_start_from_history(self):
+        store = MetricsStore()
+        for i in range(3):
+            self._seed_history(store, f"old-{i}")
+        plan = optimize_job_create_resource(store, "new",
+                                            {"param_count": 7e9})
+        assert plan["node_group_resources"]["worker"]["count"] == 6
+
+    def test_cold_start_filters_dissimilar_models(self):
+        store = MetricsStore()
+        self._seed_history(store, "tiny", count=1)
+        store2_records = store.query(job_name="tiny", record_type="model")
+        assert store2_records
+        # model 100x smaller than requested → no usable history
+        plan = optimize_job_create_resource(store, "new",
+                                            {"param_count": 700e9})
+        assert plan == {}
+
+    def test_oom_algorithm_uses_peak(self):
+        store = MetricsStore()
+        store.persist("j", "runtime", {"peak_memory_mb": 20000})
+        plan = optimize_job_oom_resource(store, "j", {"memory_mb": 16384})
+        mem = plan["node_group_resources"]["worker"]["memory_mb"]
+        assert mem == 20000 * 1.8
+
+    def test_service_roundtrip_and_optimizer_fallback(self):
+        service = BrainService(host="127.0.0.1")
+        service.start()
+        try:
+            addr = f"127.0.0.1:{service.port}"
+            client = BrainClient(addr)
+            assert client.persist_metrics("j1", "job_meta",
+                                          {"worker_count": 4, "chips": 4})
+            client.persist_metrics("j1", "job_exit", {"stage": "succeeded"})
+            records = client.get_job_metrics("j1")
+            assert len(records) == 2
+            plan = client.optimize("j2", OptimizeStage.JOB_CREATE, {})
+            assert plan["node_group_resources"]["worker"]["count"] == 4
+            # BrainResourceOptimizer: brain answers job-create...
+            opt = BrainResourceOptimizer(addr, "j2")
+            resource_plan = opt.generate_plan(OptimizeStage.JOB_CREATE, {})
+            assert resource_plan.node_group_resources[
+                NodeType.WORKER].count == 4
+            # ...and falls back to local for stages brain can't answer
+            opt.stats.add_speed_sample(2, 10.0)
+            local_plan = opt.generate_plan(
+                OptimizeStage.RUNNING,
+                {"worker_count": 2, "max_worker_count": 4})
+            assert local_plan.node_group_resources[
+                NodeType.WORKER].count == 3
+        finally:
+            service.stop()
+
+
+class TestStatsCollection:
+    def test_job_collector_reports(self):
+        from dlrover_tpu.common import messages as msg
+        from dlrover_tpu.master.stats.job_collector import (
+            JobMetricCollector,
+        )
+        from dlrover_tpu.master.stats.reporter import LocalStatsReporter
+
+        reporter = LocalStatsReporter()
+        collector = JobMetricCollector("j", reporter)
+        collector.collect_node_stats(msg.NodeResourceStats(
+            node_id=0, node_type=NodeType.WORKER, cpu_percent=80,
+            memory_mb=2048,
+            chip_stats=[msg.ChipStats(index=0, duty_cycle_pct=95,
+                                      hbm_used_mb=30000)],
+        ))
+        collector.collect_model_info(msg.ModelInfo(param_count=100))
+        collector.collect_model_info(msg.ModelInfo(param_count=100))
+        collector.report_job_exit("succeeded")
+        assert len(reporter.records("model")) == 1  # deduped
+        assert reporter.records("job_exit")[0]["stage"] == "succeeded"
+        sample = collector.stats.latest_node_sample(NodeType.WORKER, 0)
+        assert sample.chip_duty_cycle_pct == 95
