@@ -82,20 +82,23 @@ def lower(context: ModelContext) -> AccelerateResult:
 
     # -- mesh ----------------------------------------------------------
     dims = dict(plan.mesh_dims)
+    unknown = sorted(set(dims) - set(MeshAxis.ALL))
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {unknown}; valid axes: {MeshAxis.ALL}")
     if plan.fsdp and dims.get(MeshAxis.FSDP, 0) <= 1:
         # fsdp requested without an explicit size: the fsdp axis absorbs
-        # every device not claimed by other model axes (data stays 1 —
-        # batch is sharded over (data, fsdp) jointly anyway)
+        # every device not claimed by other axes (incl. an explicit data
+        # dim; with no data dim, data is pinned to 1 — batch is sharded
+        # over (data, fsdp) jointly anyway)
         fixed = 1
         for axis, size in dims.items():
-            if axis not in (MeshAxis.FSDP, MeshAxis.DATA):
+            if axis != MeshAxis.FSDP:
                 fixed *= size
         if n_devices % fixed == 0 and n_devices // fixed > 1:
             dims[MeshAxis.FSDP] = n_devices // fixed
             dims.setdefault(MeshAxis.DATA, 1)
-    spec_kwargs = {axis: size for axis, size in dims.items()
-                   if axis in MeshAxis.ALL}
-    spec = MeshSpec(**spec_kwargs)
+    spec = MeshSpec(**dims)
     mesh = create_mesh(spec, context.devices)
 
     # -- model edits (dataclass-config models) -------------------------
@@ -109,6 +112,8 @@ def lower(context: ModelContext) -> AccelerateResult:
             "flash" if jax.default_backend() == "tpu" else "reference")
     if plan.remat:
         updates["remat"] = True
+        if plan.remat_policy:
+            updates["remat_policy"] = plan.remat_policy
     if updates:
         if not context.replace_model_config(**updates):
             logger.info(

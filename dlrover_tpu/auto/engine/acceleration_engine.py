@@ -40,10 +40,18 @@ def search_strategy(
         results = []
         for _, i, candidate in scored:
             speed, err = dry_run(context, candidate, warmup=1, steps=steps)
-            results.append((speed, i, candidate))
             if err:
                 logger.info("candidate %s rejected: %s",
                             [n for n, _ in candidate], err[:200])
+                continue  # failed candidates never advance a rung
+            results.append((speed, i, candidate))
+        if not results:
+            logger.warning(
+                "every candidate strategy failed to dry-run; falling back "
+                "to the default baseline")
+            from dlrover_tpu.auto.accelerate import default_strategy
+
+            return default_strategy(len(context.devices))
         results.sort(key=lambda t: (-t[0], len(t[2])))
         keep = max(1, int(len(results) * keep_fraction))
         scored = results[:keep]

@@ -92,6 +92,11 @@ def optimize_job_hot_host(store: MetricsStore, job_name: str,
                           config: Optional[Dict] = None) -> Plan:
     """Hosts with pegged CPU and idle chips → more dataloader parallelism
     (and more host CPU if spec allows)."""
+    from dlrover_tpu.master.resource.local_optimizer import (
+        HOT_HOST_CPU_PCT,
+        IDLE_CHIP_DUTY_PCT,
+    )
+
     hot = 0
     total = 0
     for record in store.query(job_name=job_name, record_type="runtime",
@@ -100,8 +105,9 @@ def optimize_job_hot_host(store: MetricsStore, job_name: str,
         if "cpu_percent" not in payload:
             continue
         total += 1
-        if (payload.get("cpu_percent", 0) >= 90
-                and payload.get("chip_duty_cycle_pct", 100) < 50):
+        if (payload.get("cpu_percent", 0) >= HOT_HOST_CPU_PCT
+                and payload.get("chip_duty_cycle_pct", 100)
+                < IDLE_CHIP_DUTY_PCT):
             hot += 1
     if total and hot / total >= 0.3:
         return {"dataloader_workers": 2}

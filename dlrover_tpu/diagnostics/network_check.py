@@ -66,7 +66,7 @@ def probe_main() -> int:
     n = jax.device_count()
     if n > 1:
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         mesh = Mesh(jax.devices(), ("probe",))
         data = jnp.ones((n, _ALLGATHER_FLOATS), jnp.float32)

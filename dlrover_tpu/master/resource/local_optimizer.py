@@ -29,8 +29,10 @@ from dlrover_tpu.master.resource.stats_collector import RuntimeStatsCollector
 # Sizing margins (reference uses 1.2-1.5 factors for cpu/mem headroom).
 _CPU_HEADROOM = 1.25
 _MEM_HEADROOM = 1.4
-_HOT_HOST_CPU_PCT = 90.0
-_IDLE_CHIP_DUTY_PCT = 50.0
+# Hot-host (input-bound) thresholds — shared with the brain's
+# optimize_job_hot_host so the two detectors cannot diverge.
+HOT_HOST_CPU_PCT = 90.0
+IDLE_CHIP_DUTY_PCT = 50.0
 
 
 class LocalResourceOptimizer(ResourceOptimizer):
@@ -142,9 +144,9 @@ class LocalResourceOptimizer(ResourceOptimizer):
         hot = 0
         for node_id in self.stats.node_ids(NodeType.WORKER):
             sample = self.stats.latest_node_sample(NodeType.WORKER, node_id)
-            if (sample and sample.cpu_percent >= _HOT_HOST_CPU_PCT
+            if (sample and sample.cpu_percent >= HOT_HOST_CPU_PCT
                     and 0 < sample.chip_duty_cycle_pct
-                    < _IDLE_CHIP_DUTY_PCT):
+                    < IDLE_CHIP_DUTY_PCT):
                 hot += 1
         if hot:
             logger.info("detected %d input-bound (hot) hosts", hot)

@@ -54,6 +54,9 @@ class ResourcePlan:
             if limits.max_memory_mb:
                 group.node_resource.memory_mb = min(
                     group.node_resource.memory_mb, limits.max_memory_mb)
+            if limits.max_chips:
+                group.node_resource.chips = min(group.node_resource.chips,
+                                                limits.max_chips)
             if limits.max_nodes:
                 group.count = min(group.count, limits.max_nodes)
         return self

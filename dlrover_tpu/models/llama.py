@@ -27,6 +27,7 @@ from dlrover_tpu.ops.flash_attention import (
     reference_attention,
 )
 from dlrover_tpu.ops.norms import reference_rms_norm
+from dlrover_tpu.ops.remat import resolve_remat_policy
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,6 +45,8 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32   # master parameter dtype
     attn_impl: str = "flash"         # "flash" | "reference"
     remat: bool = False              # rematerialize each block
+    # "full"/"nothing_saveable" | "dots"/"dots_saveable" | "dots_with_no_batch_dims"
+    remat_policy: str = "nothing_saveable"
     tie_embeddings: bool = False
 
     @property
@@ -227,7 +230,7 @@ class Llama(nn.Module):
         if cfg.remat:
             block_cls = nn.remat(
                 DecoderBlock, static_argnums=(),
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=resolve_remat_policy(cfg.remat_policy),
             )
         for layer in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{layer}")(x, positions)

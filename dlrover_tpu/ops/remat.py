@@ -1,0 +1,24 @@
+"""Named rematerialization policies (consumed by model configs and\nthe checkpoint/remat optimization; reference analog: atorch\nactivation_checkpointing.py policy selection)."""
+
+
+def resolve_remat_policy(name: str):
+    """Named rematerialization policy → jax.checkpoint_policies member.
+    "full"/"nothing_saveable" recomputes everything; "dots"/"dots_saveable"
+    keeps matmul outputs (cheaper backward, more memory)."""
+    import jax
+
+    policies = {
+        "": jax.checkpoint_policies.nothing_saveable,
+        "full": jax.checkpoint_policies.nothing_saveable,
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        "dots_with_no_batch_dims":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if name not in policies:
+        raise ValueError(f"unknown remat policy {name!r}; "
+                         f"choose from {sorted(policies)}")
+    return policies[name]
+
+

@@ -1,0 +1,197 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Capability parity: atorch modules/moe/ — `MOELayer` (moe_layer.py:161),
+`Experts` (:116), top-k gating (topk_gating.py), switch gating
+(switch_gating.py), `_AllToAll` autograd (:87), expert process groups
+(:29).
+
+TPU re-design: the classic capacity-based dispatch/combine einsum
+formulation (Mesh-TensorFlow / Switch Transformer lineage): the router
+builds a dispatch mask (tokens → expert capacity slots) and combine
+weights; expert parameters carry an "expert" logical axis mapped to the
+`expert` mesh axis, and XLA inserts the all-to-all when the dispatch
+einsum crosses the expert sharding — no explicit _AllToAll autograd
+function needed (its transpose falls out of autodiff).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.constants import MeshAxis
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    hidden_size: int = 512
+    expert_intermediate: int = 1024
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    jitter_noise: float = 0.0       # router input jitter (switch-style)
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+
+def _capacity(tokens_per_group: int, num_experts: int,
+              capacity_factor: float, min_capacity: int) -> int:
+    capacity = int(tokens_per_group * capacity_factor / num_experts)
+    return max(capacity, min_capacity)
+
+
+def top_k_gating(
+    router_logits: jax.Array,     # (G, S, E) groups × tokens × experts
+    top_k: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Capacity-based top-k routing.
+
+    Returns (dispatch_mask (G,S,E,C) bool, combine_weights (G,S,E,C),
+    aux_loss). Tokens over an expert's capacity are dropped (the standard
+    TPU MoE contract; the residual path keeps them alive).
+    """
+    groups, seq, num_experts = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    # load-balancing aux loss (Switch eq. 4): E * Σ_e f_e · P_e
+    top1 = jnp.argmax(probs, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(top1, num_experts), axis=1)   # (G, E)
+    p = jnp.mean(probs, axis=1)                               # (G, E)
+    aux_loss = num_experts * jnp.mean(jnp.sum(f * p, axis=-1))
+
+    # iteratively take the k best experts per token
+    dispatch = jnp.zeros((groups, seq, num_experts, capacity),
+                         dtype=jnp.bool_)
+    combine = jnp.zeros((groups, seq, num_experts, capacity),
+                        dtype=jnp.float32)
+    remaining = probs
+    # slots already used per expert, carried across the k rounds
+    fill = jnp.zeros((groups, num_experts), dtype=jnp.int32)
+    for _ in range(top_k):
+        expert_idx = jnp.argmax(remaining, axis=-1)           # (G, S)
+        gate = jnp.take_along_axis(remaining, expert_idx[..., None],
+                                   axis=-1)[..., 0]           # (G, S)
+        onehot = jax.nn.one_hot(expert_idx, num_experts,
+                                dtype=jnp.int32)              # (G, S, E)
+        # position of each token in its expert's queue this round
+        position = jnp.cumsum(onehot, axis=1) - 1 + fill[:, None, :]
+        position = jnp.sum(position * onehot, axis=-1)        # (G, S)
+        within = position < capacity
+        slot_onehot = (
+            jax.nn.one_hot(position, capacity, dtype=jnp.float32)
+            * (onehot.sum(-1) * within)[..., None])           # (G, S, C)
+        this_dispatch = (onehot[..., None] *
+                         slot_onehot[:, :, None, :]).astype(jnp.bool_)
+        dispatch = dispatch | this_dispatch
+        combine = combine + this_dispatch * gate[..., None, None]
+        fill = fill + jnp.sum(onehot * within[..., None].astype(jnp.int32),
+                              axis=1)
+        remaining = remaining * (1.0 - onehot.astype(remaining.dtype))
+    # renormalize combine weights over the selected experts
+    denom = jnp.sum(combine, axis=(2, 3), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine, aux_loss
+
+
+class ExpertMLP(nn.Module):
+    """E parallel feed-forward experts; params carry the 'expert' logical
+    axis so EP shards them (atorch Experts analog)."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        # x: (E, C_total, H)
+        cfg = self.cfg
+        wi = self.param(
+            "wi",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")),
+            (cfg.num_experts, cfg.hidden_size, cfg.expert_intermediate),
+            cfg.param_dtype,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "mlp", "embed")),
+            (cfg.num_experts, cfg.expert_intermediate, cfg.hidden_size),
+            cfg.param_dtype,
+        )
+        x = x.astype(cfg.dtype)
+        h = jnp.einsum("ech,ehm->ecm", x, wi.astype(cfg.dtype))
+        h = nn.gelu(h)
+        return jnp.einsum("ecm,emh->ech", h, wo.astype(cfg.dtype))
+
+
+class MoELayer(nn.Module):
+    """Drop-in MLP replacement: (..., S, H) → (..., S, H) + aux loss via
+    `self.sow('losses', 'moe_aux_loss', ...)` (atorch MOELayer analog)."""
+
+    cfg: MoEConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        orig_shape = x.shape
+        hidden = orig_shape[-1]
+        # flatten leading dims into routing groups
+        x = x.reshape((-1,) + orig_shape[-2:])    # (G, S, H)
+        groups, seq, _ = x.shape
+
+        router = self.param(
+            "router",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "expert")),
+            (hidden, cfg.num_experts),
+            jnp.float32,
+        )
+        router_in = x.astype(jnp.float32)
+        if cfg.jitter_noise > 0 and not self.deterministic:
+            rng = self.make_rng("gating")
+            router_in = router_in * jax.random.uniform(
+                rng, router_in.shape, minval=1.0 - cfg.jitter_noise,
+                maxval=1.0 + cfg.jitter_noise)
+        logits = router_in @ router                # (G, S, E)
+
+        capacity = _capacity(seq, cfg.num_experts,
+                             cfg.capacity_factor if not self.deterministic
+                             else cfg.eval_capacity_factor,
+                             cfg.min_capacity)
+        capacity = min(capacity, seq)
+        dispatch, combine, aux_loss = top_k_gating(
+            logits, cfg.top_k, capacity)
+        self.sow("losses", "moe_aux_loss", cfg.aux_loss_weight * aux_loss)
+
+        # dispatch: (G,S,E,C) × (G,S,H) → (E, G*C, H); the contraction
+        # crossing the expert-sharded dim is where XLA places the
+        # all-to-all when E is sharded over the expert mesh axis
+        expert_in = jnp.einsum("gsec,gsh->egch",
+                               dispatch.astype(x.dtype), x)
+        expert_in = expert_in.reshape(cfg.num_experts,
+                                      groups * capacity, hidden)
+        expert_in = nn.with_logical_constraint(
+            expert_in, ("expert", None, "embed"))
+        expert_out = ExpertMLP(cfg)(expert_in)
+        expert_out = expert_out.reshape(cfg.num_experts, groups, capacity,
+                                        hidden)
+        out = jnp.einsum("gsec,egch->gsh",
+                         combine.astype(expert_out.dtype), expert_out)
+        return out.reshape(orig_shape).astype(x.dtype)
+
+
+def moe_aux_loss(variables) -> jax.Array:
+    """Collect sown aux losses from a model's 'losses' collection."""
+    losses = variables.get("losses", {})
+    total = 0.0
+    for leaf in jax.tree.leaves(losses):
+        total = total + jnp.sum(leaf)
+    return total

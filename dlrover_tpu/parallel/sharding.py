@@ -27,6 +27,7 @@ DEFAULT_RULES: List[Tuple[str, Optional[Any]]] = [
     ("kv", MeshAxis.TENSOR),
     ("mlp", MeshAxis.TENSOR),
     ("embed", MeshAxis.FSDP),
+    ("expert", MeshAxis.EXPERT),
     ("norm", None),
 ]
 
