@@ -21,6 +21,8 @@ import dataclasses
 import os
 import signal
 import subprocess
+import tempfile
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -48,6 +50,11 @@ class WorkerSpec:
     # checkpoint commit (the worker saves on SIGTERM, elastic_loop.py).
     shutdown_grace_s: float = 120.0
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # side monitors (resource/step reporting, tuned-config polling)
+    enable_monitors: bool = True
+    # restart the worker when step progress stalls (atorch
+    # --relaunch_on_hanging analog)
+    relaunch_on_hanging: bool = False
 
 
 class RendezvousTimeoutError(TimeoutError):
@@ -66,6 +73,15 @@ class ElasticAgent:
         self._proc: Optional[subprocess.Popen] = None
         self.last_world: Dict[int, int] = {}
         self.last_round = -1
+        self._monitors: List = []
+        self._hang_detector = None
+        # set by the HangingDetector thread; consumed (and acted on) only
+        # by the main run() loop so worker restarts never race
+        self._hang_event = threading.Event()
+        self._workdir = tempfile.mkdtemp(prefix="dlrover-tpu-agent-")
+        self.metrics_file = os.path.join(self._workdir, "metrics.jsonl")
+        self.chip_stats_file = os.path.join(self._workdir, "chips.json")
+        self.paral_config_file = os.path.join(self._workdir, "paral.json")
 
     # -- rendezvous --------------------------------------------------------
     def rendezvous(self) -> Tuple[int, Dict[int, int]]:
@@ -109,6 +125,9 @@ class ElasticAgent:
             NodeEnv.COORDINATOR_ADDR: coord,
             NodeEnv.RDZV_ROUND: str(rdzv_round),
             NodeEnv.DEVICES_PER_NODE: str(self._spec.devices_per_node),
+            NodeEnv.METRICS_FILE: self.metrics_file,
+            NodeEnv.CHIP_STATS_FILE: self.chip_stats_file,
+            NodeEnv.PARAL_CONFIG_PATH: self.paral_config_file,
         })
         return env
 
@@ -141,12 +160,51 @@ class ElasticAgent:
         if count_against_budget:
             self._restart_count += 1
         self._spawn()
+        if self._hang_detector is not None:
+            self._hang_detector.reset()  # fresh compile grace period
+
+    def _start_monitors(self) -> None:
+        if not self._spec.enable_monitors:
+            return
+        from dlrover_tpu.agent.monitor import (
+            HangingDetector,
+            ParalConfigTuner,
+            ResourceMonitor,
+            TrainingMonitor,
+        )
+
+        self._monitors = [
+            ResourceMonitor(self._client,
+                            chip_stats_file=self.chip_stats_file),
+            TrainingMonitor(self._client, self.metrics_file),
+            ParalConfigTuner(self._client, self.paral_config_file),
+        ]
+        if self._spec.relaunch_on_hanging:
+            self._hang_detector = HangingDetector(
+                self.metrics_file,
+                on_hang=self._hang_event.set,
+            )
+            self._monitors.append(self._hang_detector)
+        for monitor in self._monitors:
+            monitor.start()
+
+    def _stop_monitors(self) -> None:
+        for monitor in self._monitors:
+            monitor.stop()
+        self._monitors = []
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> int:
         """Monitor loop (reference: _invoke_run training.py:429-521).
         Returns the worker's final exit code."""
         self._spawn()
+        self._start_monitors()
+        try:
+            return self._run_loop()
+        finally:
+            self._stop_monitors()
+
+    def _run_loop(self) -> int:
         spec = self._spec
         while True:
             time.sleep(spec.monitor_interval_s)
@@ -172,6 +230,13 @@ class ElasticAgent:
                 )
                 self._restart_worker(count_against_budget=True)
                 continue
+            # Hang flagged by the detector thread: restart HERE so only
+            # the main loop ever touches the worker process.
+            if self._hang_event.is_set():
+                self._hang_event.clear()
+                logger.error("restarting hanged worker")
+                self._restart_worker(count_against_budget=False)
+                continue
             # Healthy: restart on membership change so the world re-forms
             # (reference: training.py:483-486,510-521).
             try:
@@ -186,6 +251,7 @@ class ElasticAgent:
                 self._restart_worker(count_against_budget=False)
 
     def shutdown(self) -> None:
+        self._stop_monitors()
         self._stop_worker()
 
 

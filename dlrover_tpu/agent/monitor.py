@@ -1,0 +1,283 @@
+"""Agent-side monitors: node resources, training progress, hang detection.
+
+Capability parity:
+- `ResourceMonitor` ≙ elastic_agent/monitor/resource.py:86 (psutil +
+  pynvml → here psutil + jax TPU memory_stats) reporting every 15 s;
+- `TrainingMonitor` ≙ elastic_agent/monitor/training.py:78
+  (TorchTrainingMonitor reads a metrics file the training process appends
+  to and forwards global step to the master);
+- `HangingDetector` ≙ atorch/fault_tolerance/hanging_detector.py:86
+  (heartbeat thread + no-progress window ⇒ restart workers).
+
+The training process writes `{"step": N, "ts": ...}` JSON lines to the
+metrics file named by `NodeEnv.METRICS_FILE` (the `report_step` helper);
+the agent-side monitors never import jax into the training process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def report_step(step: int, path: Optional[str] = None) -> None:
+    """Called from the TRAINING process each step (or every k steps)."""
+    path = path or os.environ.get(NodeEnv.METRICS_FILE, "")
+    if not path:
+        return
+    with open(path, "a") as f:
+        f.write(json.dumps({"step": int(step), "ts": time.time()}) + "\n")
+
+
+def _read_last_step(path: str) -> Optional[dict]:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 4096))
+            lines = f.read().decode(errors="ignore").strip().splitlines()
+        for line in reversed(lines):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    except OSError:
+        return None
+    return None
+
+
+class ResourceMonitor:
+    """Report host cpu/mem + TPU chip stats to the master periodically."""
+
+    def __init__(self, client: MasterClient, node_type: str = "worker",
+                 interval_s: Optional[float] = None,
+                 chip_stats_file: str = ""):
+        self._client = client
+        self._node_type = node_type
+        self._interval_s = (interval_s if interval_s is not None
+                            else Context.singleton()
+                            .report_resource_interval_s)
+        # explicit path wins; env is the worker-process export contract
+        self._chip_stats_file = chip_stats_file
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample(self) -> msg.NodeResourceStats:
+        cpu_percent = 0.0
+        memory_mb = 0.0
+        try:
+            import psutil
+
+            cpu_percent = psutil.cpu_percent(interval=None)
+            process_rss = 0
+            memory_mb = psutil.virtual_memory().used / (1 << 20)
+        except ImportError:  # psutil is present in the image; belt+braces
+            pass
+        return msg.NodeResourceStats(
+            node_id=self._client.node_id,
+            node_type=self._node_type,
+            cpu_percent=cpu_percent,
+            memory_mb=memory_mb,
+            chip_stats=self._chip_stats(),
+        )
+
+    def _chip_stats(self) -> List[msg.ChipStats]:
+        """TPU HBM usage via jax memory_stats (the pynvml analog). Only
+        meaningful in a process that owns the chips; the agent reads a
+        stats file exported by the worker when available."""
+        path = (self._chip_stats_file
+                or os.environ.get(NodeEnv.CHIP_STATS_FILE, ""))
+        if not path or not os.path.exists(path):
+            return []
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            return [msg.ChipStats(**chip) for chip in raw]
+        except (OSError, json.JSONDecodeError, TypeError):
+            return []
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="resource-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self._client.report_resource_stats(self.sample())
+                self._client.report_heartbeat()
+            except Exception as e:  # noqa: BLE001 - monitoring best-effort
+                logger.warning("resource report failed: %s", e)
+
+
+def export_chip_stats(path: Optional[str] = None) -> None:
+    """Called from the TRAINING process: dump per-chip HBM usage for the
+    agent's ResourceMonitor to relay."""
+    path = path or os.environ.get(NodeEnv.CHIP_STATS_FILE, "")
+    if not path:
+        return
+    import jax
+
+    stats = []
+    for device in jax.local_devices():
+        mem = device.memory_stats() or {}
+        stats.append({
+            "index": device.id,
+            "duty_cycle_pct": 0.0,
+            "hbm_used_mb": mem.get("bytes_in_use", 0) / (1 << 20),
+            "hbm_total_mb": mem.get("bytes_limit", 0) / (1 << 20),
+        })
+    with open(path, "w") as f:
+        json.dump(stats, f)
+
+
+class TrainingMonitor:
+    """Tail the worker's metrics file; forward global step to the master."""
+
+    def __init__(self, client: MasterClient, metrics_file: str,
+                 interval_s: float = 15.0):
+        self._client = client
+        self._metrics_file = metrics_file
+        self._interval_s = interval_s
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_reported = -1
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="training-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def last_progress_time(self) -> float:
+        record = _read_last_step(self._metrics_file)
+        return record["ts"] if record else 0.0
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            record = _read_last_step(self._metrics_file)
+            if record and record["step"] > self._last_reported:
+                self._last_reported = record["step"]
+                try:
+                    self._client.report_global_step(record["step"])
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("step report failed: %s", e)
+
+
+class HangingDetector:
+    """Restart the worker when no step progress for `hang_seconds`
+    (atorch --relaunch_on_hanging analog)."""
+
+    def __init__(
+        self,
+        metrics_file: str,
+        on_hang: Callable[[], None],
+        hang_seconds: Optional[float] = None,
+        check_interval_s: float = 30.0,
+        warmup_s: float = 300.0,
+    ):
+        self._metrics_file = metrics_file
+        self._on_hang = on_hang
+        self._hang_seconds = (hang_seconds if hang_seconds is not None
+                              else Context.singleton().hang_seconds)
+        self._check_interval_s = check_interval_s
+        self._warmup_s = warmup_s
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started_at = time.time()
+
+    def start(self) -> None:
+        self._started_at = time.time()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hang-detector")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def reset(self) -> None:
+        """Call after a worker restart (fresh compile grace period)."""
+        self._started_at = time.time()
+
+    def is_hanged(self) -> bool:
+        record = _read_last_step(self._metrics_file)
+        now = time.time()
+        if record is None:
+            # no step ever: hang only after warmup (first compile is slow)
+            return now - self._started_at > max(self._warmup_s,
+                                                self._hang_seconds)
+        # a stale record from before the last (re)start must not re-fire:
+        # progress is the newer of last-step time and last restart time
+        last_progress = max(record["ts"], self._started_at)
+        return now - last_progress > self._hang_seconds
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._check_interval_s):
+            if self.is_hanged():
+                logger.error("hang detected: no step progress for %.0fs",
+                             self._hang_seconds)
+                try:
+                    self._on_hang()
+                finally:
+                    self.reset()
+
+
+class ParalConfigTuner:
+    """Poll the master's tuned ParallelConfig and write it to the JSON
+    file the ElasticDataLoader hot-reloads (reference:
+    elastic_agent/config/paral_config_tuner.py:30-60)."""
+
+    def __init__(self, client: MasterClient, config_path: str,
+                 interval_s: float = 30.0):
+        self._client = client
+        self._config_path = config_path
+        self._interval_s = interval_s
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_version = -1
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="paral-config-tuner")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def poll_once(self) -> bool:
+        config = self._client.get_paral_config()
+        if config.version <= self._last_version:
+            return False
+        self._last_version = config.version
+        tmp = self._config_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "version": config.version,
+                "dataloader_batch_size": config.dataloader_batch_size,
+                "dataloader_workers": config.dataloader_workers,
+                "learning_rate": config.learning_rate,
+                "grad_accum_steps": config.grad_accum_steps,
+            }, f)
+        os.replace(tmp, self._config_path)  # atomic for the reader
+        return True
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("paral config poll failed: %s", e)

@@ -93,6 +93,9 @@ class NodeEnv:
     RDZV_ROUND = "DLROVER_TPU_RDZV_ROUND"
     PARAL_CONFIG_PATH = "DLROVER_TPU_PARAL_CONFIG" # tuned-config hot-reload file
     DEVICES_PER_NODE = "DLROVER_TPU_DEVICES_PER_NODE"
+    # worker → agent handoff files (monitors tail these)
+    METRICS_FILE = "DLROVER_TPU_METRICS_FILE"      # step-progress JSON lines
+    CHIP_STATS_FILE = "DLROVER_TPU_CHIP_STATS"     # per-chip HBM usage JSON
 
 
 class TrainingMsgLevel:

@@ -1,0 +1,15 @@
+"""Data pipeline: device prefetch, shared-memory coworker IPC, services.
+
+Capability parity: atorch/data/ —
+- `prefetch_to_device` ≙ data/preloader.py (CUDA-stream prefetch → here
+  double-buffered async device_put)
+- `ShmRing`/`ShmDataContext` ≙ data/shm_context.py:139 (C++ ring, ctypes)
+- `CoworkerDataService` ≙ atorch/service/coworker_data_service.py (gRPC
+  batches from CPU pods)
+- `ElasticDataLoader` lives in dlrover_tpu/trainer/dataloader.py
+"""
+
+from dlrover_tpu.data.prefetch import prefetch_to_device
+from dlrover_tpu.data.shm_ring import ShmDataContext, ShmRing
+
+__all__ = ["prefetch_to_device", "ShmDataContext", "ShmRing"]

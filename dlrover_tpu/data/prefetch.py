@@ -1,0 +1,40 @@
+"""Device prefetch: overlap host batch prep with device compute.
+
+Capability parity: atorch data/preloader.py (CUDA-stream prefetch). TPU
+re-design: `jax.device_put` is async — keeping `depth` batches in flight
+overlaps the host→HBM DMA of batch i+1 with the step on batch i (the
+stream role is played by XLA's async dispatch).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+
+
+def prefetch_to_device(
+    iterator: Iterable,
+    depth: int = 2,
+    sharding: Optional[Any] = None,
+    transform: Optional[Callable] = None,
+) -> Iterator:
+    """Yield batches already on device, `depth` ahead of consumption."""
+    queue: collections.deque = collections.deque()
+
+    def put(batch):
+        if transform is not None:
+            batch = transform(batch)
+        if sharding is not None:
+            return jax.tree.map(
+                lambda x: jax.device_put(x, sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    it = iter(iterator)
+    for batch in it:
+        queue.append(put(batch))
+        if len(queue) >= depth:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

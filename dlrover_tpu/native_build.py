@@ -1,0 +1,102 @@
+"""Build/load the native library (shm ring + reconciler core).
+
+`python -m dlrover_tpu.native_build` builds it explicitly; importers call
+`load_native()` which builds on first use (g++ is in the image) and caches
+the handle. Consumers degrade gracefully to pure-Python fallbacks when the
+toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_NAME = "libdlrover_tpu_native.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def so_path() -> str:
+    return os.path.join(_NATIVE_DIR, _SO_NAME)
+
+
+def build(force: bool = False) -> bool:
+    src_newer = False
+    if os.path.exists(so_path()) and not force:
+        so_mtime = os.path.getmtime(so_path())
+        for name in os.listdir(_NATIVE_DIR):
+            if name.endswith(".cpp"):
+                if os.path.getmtime(
+                        os.path.join(_NATIVE_DIR, name)) > so_mtime:
+                    src_newer = True
+        if not src_newer:
+            return True
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, text=True, timeout=120)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        logger.warning("native build failed: %s", detail[-2000:])
+        return False
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if not build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so_path())
+        except OSError as e:
+            logger.warning("native load failed: %s", e)
+            _load_failed = True
+            return None
+        # -- shm ring signatures --
+        lib.shm_ring_open.restype = ctypes.c_void_p
+        lib.shm_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                      ctypes.c_int]
+        lib.shm_ring_capacity.restype = ctypes.c_uint32
+        lib.shm_ring_capacity.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_push.restype = ctypes.c_int
+        lib.shm_ring_push.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_uint8),
+                                      ctypes.c_uint32, ctypes.c_int64]
+        lib.shm_ring_next_len.restype = ctypes.c_int64
+        lib.shm_ring_next_len.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_pop.restype = ctypes.c_int64
+        lib.shm_ring_pop.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint8),
+                                     ctypes.c_uint32, ctypes.c_int64]
+        lib.shm_ring_mark_closed.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+        # -- reconciler signatures --
+        lib.reconciler_abi_version.restype = ctypes.c_int32
+        _lib = lib
+        return _lib
+
+
+def main() -> int:
+    ok = build(force=True)
+    print(f"native build: {'ok' if ok else 'FAILED'} ({so_path()})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

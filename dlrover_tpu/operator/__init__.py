@@ -1,0 +1,14 @@
+"""ElasticJob operator: reconcile loop + master-pod lifecycle.
+
+Capability parity: the Go operator (dlrover/go/operator/ — ElasticJob/
+ScalePlan CRDs elasticjob_types.go:29-123, Reconcile
+elasticjob_controller.go:85, master pod master/master.go:53-162). The
+decision core is native C++ (native/reconciler.cpp) behind ctypes; this
+package is the actuation shell (k8s REST or the in-memory LocalCluster).
+"""
+
+from dlrover_tpu.operator.native import Action, ActionKind, JobObserved, reconcile
+from dlrover_tpu.operator.controller import ElasticJobController
+
+__all__ = ["Action", "ActionKind", "JobObserved", "reconcile",
+           "ElasticJobController"]

@@ -1,0 +1,171 @@
+"""Operator actuation shell: observe → native reconcile → act.
+
+Capability parity: ElasticJobReconciler (elasticjob_controller.go:85) +
+master.Manager (master/master.go:53-162: master pod/service construction,
+DLROVER_MASTER_ADDR injection) + ScalePlanReconciler relay. Runs against
+the in-memory LocalCluster (tests/standalone) or the k8s REST client.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.operator.native import (
+    Action,
+    ActionKind,
+    JobObserved,
+    JobPhase,
+    PodPhase,
+    reconcile,
+)
+
+_POD_STATUS_TO_PHASE = {
+    NodeStatus.PENDING: PodPhase.PENDING,
+    NodeStatus.RUNNING: PodPhase.RUNNING,
+    NodeStatus.SUCCEEDED: PodPhase.SUCCEEDED,
+    NodeStatus.FAILED: PodPhase.FAILED,
+    NodeStatus.BREAKDOWN: PodPhase.FAILED,
+}
+
+PHASE_NAMES = {
+    JobPhase.CREATED: "Created",
+    JobPhase.PENDING: "Pending",
+    JobPhase.RUNNING: "Running",
+    JobPhase.SUCCEEDED: "Succeeded",
+    JobPhase.FAILED: "Failed",
+    JobPhase.SCALING: "Scaling",
+}
+
+
+class ElasticJobController:
+    """One controller per job against the LocalCluster backend (the k8s
+    shell wires the same reconcile core to K8sClient CRUD)."""
+
+    def __init__(
+        self,
+        job_name: str,
+        cluster,                       # LocalCluster
+        master_factory=None,           # () -> started master; returns addr
+        max_master_restarts: int = 3,
+        interval_s: float = 1.0,
+    ):
+        self._job_name = job_name
+        self._cluster = cluster
+        self._master_factory = master_factory
+        self._interval_s = interval_s
+        self.phase = JobPhase.CREATED
+        self.master_restarts = 0
+        self.max_master_restarts = max_master_restarts
+        self.master_addr = ""
+        self.pending_scale_plan: Optional[msg.ScaleRequest] = None
+        self.suspended = False
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._master_handle = None
+
+    # -- observation ---------------------------------------------------
+    def observe(self) -> JobObserved:
+        master_phase = PodPhase.ABSENT
+        for pod in self._cluster.list_pods(NodeType.MASTER):
+            master_phase = _POD_STATUS_TO_PHASE.get(pod.status,
+                                                    PodPhase.ABSENT)
+        workers = self._cluster.list_pods(NodeType.WORKER)
+        return JobObserved(
+            job_phase=self.phase,
+            master_phase=master_phase,
+            master_restarts=self.master_restarts,
+            max_master_restarts=self.max_master_restarts,
+            suspended=self.suspended,
+            pending_scale_plan=self.pending_scale_plan is not None,
+            workers_total=len(workers),
+            workers_running=sum(
+                1 for p in workers if p.status == NodeStatus.RUNNING),
+            workers_succeeded=sum(
+                1 for p in workers if p.status == NodeStatus.SUCCEEDED),
+            workers_failed_unrecoverable=sum(
+                1 for p in workers if p.status == NodeStatus.FAILED),
+        )
+
+    # -- actuation -------------------------------------------------------
+    def _act(self, action: Action) -> None:
+        if action.kind == ActionKind.CREATE_MASTER:
+            self._create_master()
+        elif action.kind == ActionKind.RELAUNCH_MASTER:
+            self.master_restarts = action.arg
+            logger.warning("relaunching master (%d/%d)",
+                           self.master_restarts, self.max_master_restarts)
+            for pod in self._cluster.list_pods(NodeType.MASTER):
+                self._cluster.delete_pod(pod.name)
+            self._create_master()
+        elif action.kind == ActionKind.SET_PHASE:
+            if self.phase != action.arg:
+                logger.info("job %s phase -> %s", self._job_name,
+                            PHASE_NAMES[action.arg])
+                self.phase = action.arg
+        elif action.kind == ActionKind.RELAY_SCALE_PLAN:
+            self._relay_scale_plan()
+        elif action.kind == ActionKind.FAIL_JOB:
+            logger.error("job %s failed (reason code %d)", self._job_name,
+                         action.arg)
+
+    def _create_master(self) -> None:
+        from dlrover_tpu.scheduler.local import PodRecord
+
+        if self._master_factory is not None:
+            self._master_handle, self.master_addr = self._master_factory()
+        self._cluster.create_pod(PodRecord(
+            name=f"{self._job_name}-master-0",
+            node_type=NodeType.MASTER,
+            node_id=0,
+            rank_index=0,
+            env={"DLROVER_TPU_MASTER_ADDR": self.master_addr},
+        ))
+
+    def _relay_scale_plan(self) -> None:
+        plan = self.pending_scale_plan
+        self.pending_scale_plan = None
+        if plan is None or not self.master_addr:
+            return
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        try:
+            client = MasterClient(self.master_addr, node_id=-1)
+            client._report(plan)
+            client.close()
+            logger.info("relayed scale plan %s=%d to master",
+                        plan.node_type, plan.count)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("scale-plan relay failed: %s; requeued", e)
+            self.pending_scale_plan = plan
+
+    def submit_scale_plan(self, node_type: str, count: int) -> None:
+        """The ScalePlan-CR entry (reference: ScalePlanReconciler)."""
+        self.pending_scale_plan = msg.ScaleRequest(node_type=node_type,
+                                                   count=count)
+
+    # -- loop ------------------------------------------------------------
+    def reconcile_once(self) -> JobObserved:
+        observed = self.observe()
+        for action in reconcile(observed):
+            self._act(action)
+        return observed
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="elasticjob-controller")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.reconcile_once()
+            except Exception as e:  # noqa: BLE001 - controller must survive
+                logger.error("reconcile failed: %s", e)

@@ -1,0 +1,20 @@
+"""Optimizer family (optax gradient transformations).
+
+Capability parity: atorch/optim + atorch/optimizers —
+- `agd`            ≙ atorch/optim/agd.py (AGD, NeurIPS'23: gradient-
+                     difference preconditioner with auto SGD/adaptive switch)
+- `wsam_*`         ≙ atorch/optimizers/wsam.py (WSAM, KDD'23 weighted
+                     sharpness-aware minimization)
+- `bf16_master`    ≙ atorch/optim/bf16_optimizer.py (bf16 params with
+                     fp32 master copies)
+- `row_sparse_adagrad` ≙ atorch/optim/sparse adagrad/adam (embedding-row
+                     sparse updates)
+"""
+
+from dlrover_tpu.optim.agd import agd
+from dlrover_tpu.optim.bf16 import bf16_master
+from dlrover_tpu.optim.sparse import row_sparse_adagrad
+from dlrover_tpu.optim.wsam import wsam_value_and_grad
+
+__all__ = ["agd", "bf16_master", "row_sparse_adagrad",
+           "wsam_value_and_grad"]

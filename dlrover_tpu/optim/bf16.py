@@ -1,0 +1,50 @@
+"""bf16 training with fp32 master parameters.
+
+Capability parity: atorch/optim/bf16_optimizer.py (265 LoC: fp32 master
+weights + bf16 model weights, update in fp32, copy back). As an optax
+wrapper: the state carries the fp32 master copy; the inner transformation
+runs entirely in fp32; the emitted update is the bf16 delta, so the
+visible params stay bf16 while accumulation error does not compound.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class BF16MasterState(NamedTuple):
+    master: optax.Params     # fp32 copy
+    inner: optax.OptState
+
+
+def bf16_master(
+    inner: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        master = jax.tree.map(
+            lambda p: p.astype(jnp.float32)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+        return BF16MasterState(master=master, inner=inner.init(master))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("bf16_master requires params")
+        grads32 = jax.tree.map(
+            lambda g: g.astype(jnp.float32)
+            if jnp.issubdtype(g.dtype, jnp.floating) else g, updates)
+        inner_updates, inner_state = inner.update(
+            grads32, state.inner, state.master)
+        new_master = optax.apply_updates(state.master, inner_updates)
+        # emitted update reproduces the bf16 image of the fp32 master
+        new_updates = jax.tree.map(
+            lambda nm, p: nm.astype(p.dtype) - p
+            if jnp.issubdtype(p.dtype, jnp.floating) else jnp.zeros_like(p),
+            new_master, params)
+        return new_updates, BF16MasterState(master=new_master,
+                                            inner=inner_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)

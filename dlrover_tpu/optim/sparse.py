@@ -1,0 +1,59 @@
+"""Row-sparse adagrad for embedding tables.
+
+Capability parity: atorch/optim/ sparse adagrad/adam — only embedding rows
+touched in the step get accumulator/parameter updates. TPU re-design: XLA
+has no sparse tensors; "sparse" means masking by row activity (rows with
+zero gradient stay bit-identical, including their accumulators), which is
+exactly the semantics sparse optimizers give embeddings.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class RowSparseAdagradState(NamedTuple):
+    accumulator: optax.Updates
+
+
+def row_sparse_adagrad(
+    learning_rate: float = 0.1,
+    initial_accumulator: float = 0.1,
+    eps: float = 1e-10,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        return RowSparseAdagradState(
+            accumulator=jax.tree.map(
+                lambda p: jnp.full_like(p, initial_accumulator), params))
+
+    def update_fn(updates, state, params=None):
+        del params
+
+        def one(g, acc):
+            if g.ndim < 2:
+                row_active = jnp.any(g != 0)
+            else:
+                row_active = jnp.any(
+                    g.reshape(g.shape[0], -1) != 0, axis=-1)
+                row_active = row_active.reshape(
+                    (g.shape[0],) + (1,) * (g.ndim - 1))
+            new_acc = jnp.where(row_active, acc + jnp.square(g), acc)
+            step = jnp.where(
+                row_active,
+                -learning_rate * g / (jnp.sqrt(new_acc) + eps),
+                jnp.zeros_like(g))
+            return step, new_acc
+
+        flat = jax.tree.map(one, updates, state.accumulator,
+                            is_leaf=lambda x: isinstance(x, jnp.ndarray))
+        steps = jax.tree.map(lambda pair: pair[0], flat,
+                             is_leaf=lambda x: isinstance(x, tuple))
+        accs = jax.tree.map(lambda pair: pair[1], flat,
+                            is_leaf=lambda x: isinstance(x, tuple))
+        return steps, RowSparseAdagradState(accumulator=accs)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,53 @@
+"""WSAM: weighted sharpness-aware minimization.
+
+Capability parity: atorch/optimizers/wsam.py (KDD'23 "Sharpness-Aware
+Minimization Revisited: Weighted Sharpness as a Regularization Term",
+atorch/atorch/optimizers/README.md:1-10). Minimizes
+L(w) + γ/(1-γ) · [max_{||ε||≤ρ} L(w+ε) - L(w)], i.e. the WSAM gradient is
+
+    g_wsam = g + γ/(1-γ) · (g_adv − g)       (γ=0.5 ⇒ vanilla SAM)
+
+TPU re-design: no in-place parameter perturbation / two optimizer.step
+calls — a pure `value_and_grad`-shaped function computes both gradients
+inside one jitted program (XLA overlaps the two backward passes where
+possible) and composes with any optax transformation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def wsam_value_and_grad(
+    loss_fn: Callable[..., jax.Array],
+    rho: float = 0.05,
+    gamma: float = 0.5,
+) -> Callable[..., Tuple[jax.Array, Any]]:
+    """Wrap `loss_fn(params, *args)` into WSAM (value, grad).
+
+    Use exactly like `jax.value_and_grad(loss_fn)`:
+        value_and_grad = wsam_value_and_grad(loss_fn, rho=0.05)
+        loss, grads = value_and_grad(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+    """
+    if not 0.0 <= gamma < 1.0:
+        raise ValueError(f"gamma must be in [0, 1), got {gamma}")
+    sharpness_weight = gamma / (1.0 - gamma)
+
+    def value_and_grad(params, *args, **kwargs):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *args, **kwargs)
+        grad_norm = optax.global_norm(grads)
+        scale = rho / jnp.maximum(grad_norm, 1e-12)
+        adv_params = jax.tree.map(lambda p, g: p + scale * g, params,
+                                  grads)
+        adv_grads = jax.grad(loss_fn)(adv_params, *args, **kwargs)
+        wsam_grads = jax.tree.map(
+            lambda g, ga: g + sharpness_weight * (ga - g), grads,
+            adv_grads)
+        return loss, wsam_grads
+
+    return value_and_grad

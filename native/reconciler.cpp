@@ -1,0 +1,129 @@
+// ElasticJob reconciler core.
+//
+// Capability parity: the Go operator's controller logic
+// (dlrover/go/operator/pkg/controllers/elasticjob_controller.go:85
+// Reconcile; master pod lifecycle master/master.go:53-162;
+// HandleFaultPods master/master.go:165; ScalePlan relay
+// scaleplan_controller.go). The reference implements this in Go against
+// controller-runtime; here the decision core is a dependency-free C++
+// library with a C ABI — the Python operator shell feeds it observed
+// state and actuates the actions it returns, so the control decisions
+// stay native and unit-testable.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// --- state vocabulary (keep in sync with dlrover_tpu/operator/native.py) ---
+enum PodPhase : int32_t {
+  POD_ABSENT = 0,
+  POD_PENDING = 1,
+  POD_RUNNING = 2,
+  POD_SUCCEEDED = 3,
+  POD_FAILED = 4,
+};
+
+enum JobPhase : int32_t {
+  JOB_CREATED = 0,
+  JOB_PENDING = 1,
+  JOB_RUNNING = 2,
+  JOB_SUCCEEDED = 3,
+  JOB_FAILED = 4,
+  JOB_SCALING = 5,
+};
+
+enum ActionKind : int32_t {
+  ACT_NONE = 0,
+  ACT_CREATE_MASTER = 1,     // create the job-master pod + service
+  ACT_RELAUNCH_MASTER = 2,   // master pod died and budget remains
+  ACT_SET_PHASE = 3,         // arg = JobPhase
+  ACT_RELAY_SCALE_PLAN = 4,  // forward manual ScalePlan to the master
+  ACT_FAIL_JOB = 5,          // arg = reason code
+};
+
+struct JobObserved {
+  int32_t job_phase;           // current recorded phase
+  int32_t master_phase;        // PodPhase of the master pod
+  int32_t master_restarts;     // times the master has been relaunched
+  int32_t max_master_restarts;
+  int32_t suspended;           // job paused by the user
+  int32_t pending_scale_plan;  // a ScalePlan CR awaits relay
+  int32_t workers_total;
+  int32_t workers_running;
+  int32_t workers_succeeded;
+  int32_t workers_failed_unrecoverable;
+};
+
+struct Action {
+  int32_t kind;
+  int32_t arg;
+};
+
+// Compute the next actions for one reconcile pass. Returns the number of
+// actions written (<= max_actions). Mirrors ElasticJobReconciler.Reconcile:
+// the operator only manages the MASTER; workers belong to the master.
+int32_t reconcile_elastic_job(const JobObserved* job, Action* out,
+                              int32_t max_actions) {
+  int32_t n = 0;
+  auto emit = [&](int32_t kind, int32_t arg) {
+    if (n < max_actions) {
+      out[n].kind = kind;
+      out[n].arg = arg;
+      ++n;
+    }
+  };
+
+  if (job->suspended) {
+    return n;  // suspended jobs reconcile to nothing
+  }
+  // Terminal phases are sticky.
+  if (job->job_phase == JOB_SUCCEEDED || job->job_phase == JOB_FAILED) {
+    return n;
+  }
+
+  switch (job->master_phase) {
+    case POD_ABSENT:
+      emit(ACT_CREATE_MASTER, 0);
+      if (job->job_phase != JOB_PENDING) emit(ACT_SET_PHASE, JOB_PENDING);
+      break;
+    case POD_PENDING:
+      if (job->job_phase != JOB_PENDING) emit(ACT_SET_PHASE, JOB_PENDING);
+      break;
+    case POD_RUNNING:
+      if (job->job_phase != JOB_RUNNING) emit(ACT_SET_PHASE, JOB_RUNNING);
+      if (job->pending_scale_plan) emit(ACT_RELAY_SCALE_PLAN, 0);
+      break;
+    case POD_SUCCEEDED:
+      // master exits 0 when the job finished (all workers done)
+      emit(ACT_SET_PHASE, JOB_SUCCEEDED);
+      break;
+    case POD_FAILED:
+      // HandleFaultPods: relaunch the master within budget, else fail
+      if (job->master_restarts < job->max_master_restarts) {
+        emit(ACT_RELAUNCH_MASTER, job->master_restarts + 1);
+      } else {
+        emit(ACT_FAIL_JOB, 1);
+        emit(ACT_SET_PHASE, JOB_FAILED);
+      }
+      break;
+  }
+
+  // Worker-status roll-up (job phase sync from replica statuses): the
+  // master normally reports completion itself; this is the safety net
+  // when every worker reached a terminal state but the master is gone.
+  if (job->master_phase == POD_ABSENT && job->workers_total > 0) {
+    if (job->workers_succeeded == job->workers_total) {
+      emit(ACT_SET_PHASE, JOB_SUCCEEDED);
+    } else if (job->workers_failed_unrecoverable == job->workers_total) {
+      emit(ACT_FAIL_JOB, 2);
+      emit(ACT_SET_PHASE, JOB_FAILED);
+    }
+  }
+  return n;
+}
+
+// Version tag so the Python shell can verify ABI compatibility.
+int32_t reconciler_abi_version() { return 1; }
+
+}  // extern "C"

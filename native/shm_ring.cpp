@@ -1,0 +1,227 @@
+// Shared-memory ring buffer for host-side data pipelines.
+//
+// Capability parity: atorch's ShmDataContext (atorch/data/shm_context.py:139)
+// — the shared-memory IPC ring that moves preprocessed batches from CPU
+// "coworker" processes into the training process without pickling through
+// sockets. The reference implements it in Python over
+// multiprocessing.shared_memory; here the hot path (variable-size record
+// ring with blocking push/pop) is C++ with C linkage for ctypes.
+//
+// Layout: [Header | data bytes...]; records are [u32 len | payload]
+// wrapped at the end with a SKIP sentinel. Single-producer/single-consumer
+// per ring (the Python layer shards multiple workers over multiple rings,
+// like the reference's per-worker shm blocks); head/tail are C11 atomics so
+// push/pop need no locks.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x444c5452;  // "DLTR"
+constexpr uint32_t kSkip = 0xffffffff;   // wrap sentinel
+
+struct Header {
+  uint32_t magic;
+  uint32_t capacity;                 // data bytes
+  std::atomic<uint64_t> head;        // write offset (mod capacity)
+  std::atomic<uint64_t> tail;        // read offset (mod capacity)
+  std::atomic<uint32_t> closed;
+};
+
+struct Ring {
+  Header* hdr;
+  uint8_t* data;
+  size_t map_size;
+  int fd;
+  bool owner;
+  char name[256];
+};
+
+inline uint64_t now_ms() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+inline void sleep_us(long us) {
+  timespec ts{0, us * 1000};
+  nanosleep(&ts, nullptr);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or attach (owner=0) a ring named `name` with `capacity`
+// data bytes. Returns an opaque handle or null.
+void* shm_ring_open(const char* name, uint32_t capacity, int owner) {
+  int flags = owner ? (O_CREAT | O_RDWR | O_EXCL) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0 && owner && errno == EEXIST) {
+    shm_unlink(name);  // stale ring from a dead process
+    fd = shm_open(name, flags, 0600);
+  }
+  if (fd < 0) return nullptr;
+  size_t map_size = sizeof(Header) + capacity;
+  if (owner && ftruncate(fd, (off_t)map_size) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  if (!owner) {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(Header)) {
+      close(fd);
+      return nullptr;
+    }
+    map_size = (size_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    if (owner) shm_unlink(name);
+    return nullptr;
+  }
+  Ring* ring = new Ring();
+  ring->hdr = (Header*)mem;
+  ring->data = (uint8_t*)mem + sizeof(Header);
+  ring->map_size = map_size;
+  ring->fd = fd;
+  ring->owner = owner != 0;
+  snprintf(ring->name, sizeof(ring->name), "%s", name);
+  if (owner) {
+    ring->hdr->magic = kMagic;
+    ring->hdr->capacity = capacity;
+    ring->hdr->head.store(0);
+    ring->hdr->tail.store(0);
+    ring->hdr->closed.store(0);
+  } else if (ring->hdr->magic != kMagic) {
+    munmap(mem, map_size);
+    close(fd);
+    delete ring;
+    return nullptr;
+  }
+  return ring;
+}
+
+uint32_t shm_ring_capacity(void* handle) {
+  return ((Ring*)handle)->hdr->capacity;
+}
+
+// Push one record. Blocks up to timeout_ms for space. Returns 0 ok,
+// -1 timeout, -2 closed, -3 record too large.
+int shm_ring_push(void* handle, const uint8_t* buf, uint32_t len,
+                  int64_t timeout_ms) {
+  Ring* r = (Ring*)handle;
+  Header* h = r->hdr;
+  const uint32_t cap = h->capacity;
+  const uint32_t need = len + 4;
+  if (need + 4 > cap) return -3;  // must leave room for a wrap sentinel
+  const uint64_t deadline = now_ms() + (timeout_ms < 0 ? 0 : timeout_ms);
+  for (;;) {
+    if (h->closed.load(std::memory_order_acquire)) return -2;
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    uint64_t used = head - tail;
+    uint32_t pos = (uint32_t)(head % cap);
+    uint32_t to_end = cap - pos;
+    // a record never wraps: if it doesn't fit before the end, write a
+    // SKIP sentinel and start at 0 (consumer mirrors this)
+    uint32_t effective = (to_end >= need) ? need : to_end + need;
+    if (cap - used >= effective) {
+      if (to_end < need) {
+        if (to_end >= 4) memcpy(r->data + pos, &kSkip, 4);
+        head += to_end;
+        pos = 0;
+      }
+      memcpy(r->data + pos, &len, 4);
+      memcpy(r->data + pos + 4, buf, len);
+      h->head.store(head + need, std::memory_order_release);
+      return 0;
+    }
+    if (timeout_ms >= 0 && now_ms() >= deadline) return -1;
+    sleep_us(100);
+  }
+}
+
+// Peek next record length without consuming. Returns length, 0 if empty,
+// -2 if closed and drained.
+int64_t shm_ring_next_len(void* handle) {
+  Ring* r = (Ring*)handle;
+  Header* h = r->hdr;
+  const uint32_t cap = h->capacity;
+  for (;;) {
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    if (head == tail) {
+      return h->closed.load(std::memory_order_acquire) ? -2 : 0;
+    }
+    uint32_t pos = (uint32_t)(tail % cap);
+    uint32_t to_end = cap - pos;
+    uint32_t len;
+    if (to_end < 4) {  // implicit skip (sentinel didn't fit either)
+      h->tail.store(tail + to_end, std::memory_order_release);
+      continue;
+    }
+    memcpy(&len, r->data + pos, 4);
+    if (len == kSkip) {
+      h->tail.store(tail + to_end, std::memory_order_release);
+      continue;
+    }
+    return (int64_t)len;
+  }
+}
+
+// Pop one record into buf (buf_len must be >= record length). Blocks up to
+// timeout_ms. Returns record length, -1 timeout, -2 closed+drained,
+// -3 buffer too small.
+int64_t shm_ring_pop(void* handle, uint8_t* buf, uint32_t buf_len,
+                     int64_t timeout_ms) {
+  Ring* r = (Ring*)handle;
+  Header* h = r->hdr;
+  const uint32_t cap = h->capacity;
+  const uint64_t deadline = now_ms() + (timeout_ms < 0 ? 0 : timeout_ms);
+  for (;;) {
+    int64_t len = shm_ring_next_len(handle);
+    if (len > 0) {
+      if ((uint32_t)len > buf_len) return -3;
+      uint64_t tail = h->tail.load(std::memory_order_relaxed);
+      uint32_t pos = (uint32_t)(tail % cap);
+      memcpy(buf, r->data + pos + 4, (size_t)len);
+      h->tail.store(tail + (uint32_t)len + 4, std::memory_order_release);
+      return len;
+    }
+    if (len == -2) return -2;
+    if (timeout_ms >= 0 && now_ms() >= deadline) return -1;
+    sleep_us(100);
+  }
+}
+
+void shm_ring_mark_closed(void* handle) {
+  ((Ring*)handle)->hdr->closed.store(1, std::memory_order_release);
+}
+
+// Unmap; the owner also unlinks the shm object.
+void shm_ring_close(void* handle) {
+  Ring* r = (Ring*)handle;
+  bool owner = r->owner;
+  char name[256];
+  snprintf(name, sizeof(name), "%s", r->name);
+  munmap((void*)r->hdr, r->map_size);
+  close(r->fd);
+  delete r;
+  if (owner) shm_unlink(name);
+}
+
+}  // extern "C"

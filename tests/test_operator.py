@@ -1,0 +1,126 @@
+"""Operator/reconciler tests (parity: go operator controller tests with
+fake clients, pkg/controllers/training/task_test.go)."""
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.native_build import load_native
+from dlrover_tpu.operator.controller import ElasticJobController
+from dlrover_tpu.operator.native import (
+    ActionKind,
+    JobObserved,
+    JobPhase,
+    PodPhase,
+    _native_reconcile,
+    _python_reconcile,
+    reconcile,
+)
+from dlrover_tpu.scheduler.local import LocalCluster
+
+
+CASES = [
+    # (observed, expected action kinds)
+    (JobObserved(), [ActionKind.CREATE_MASTER, ActionKind.SET_PHASE]),
+    (JobObserved(job_phase=JobPhase.PENDING,
+                 master_phase=PodPhase.RUNNING),
+     [ActionKind.SET_PHASE]),
+    (JobObserved(job_phase=JobPhase.RUNNING,
+                 master_phase=PodPhase.RUNNING), []),
+    (JobObserved(job_phase=JobPhase.RUNNING,
+                 master_phase=PodPhase.RUNNING,
+                 pending_scale_plan=True),
+     [ActionKind.RELAY_SCALE_PLAN]),
+    (JobObserved(job_phase=JobPhase.RUNNING,
+                 master_phase=PodPhase.SUCCEEDED),
+     [ActionKind.SET_PHASE]),
+    (JobObserved(job_phase=JobPhase.RUNNING,
+                 master_phase=PodPhase.FAILED, master_restarts=0),
+     [ActionKind.RELAUNCH_MASTER]),
+    (JobObserved(job_phase=JobPhase.RUNNING,
+                 master_phase=PodPhase.FAILED, master_restarts=3),
+     [ActionKind.FAIL_JOB, ActionKind.SET_PHASE]),
+    (JobObserved(job_phase=JobPhase.SUCCEEDED,
+                 master_phase=PodPhase.FAILED), []),
+    (JobObserved(suspended=True), []),
+]
+
+
+class TestReconcilerCore:
+    @pytest.mark.parametrize("observed,expected", CASES)
+    def test_decision_table(self, observed, expected):
+        actions = reconcile(observed)
+        assert [a.kind for a in actions] == expected
+
+    def test_native_library_in_use(self):
+        assert load_native() is not None
+
+    @pytest.mark.parametrize("observed,expected", CASES)
+    def test_native_and_python_agree(self, observed, expected):
+        native = [(a.kind, a.arg) for a in _native_reconcile(observed)]
+        python = [(a.kind, a.arg) for a in _python_reconcile(observed)]
+        assert native == python
+
+    def test_worker_rollup_without_master(self):
+        observed = JobObserved(
+            job_phase=JobPhase.RUNNING, master_phase=PodPhase.ABSENT,
+            workers_total=2, workers_succeeded=2)
+        kinds = [(a.kind, a.arg) for a in reconcile(observed)]
+        assert (ActionKind.SET_PHASE, JobPhase.SUCCEEDED) in kinds
+
+
+class TestController:
+    def test_full_lifecycle(self):
+        cluster = LocalCluster()
+        controller = ElasticJobController("j", cluster)
+        # pass 1: creates the master pod
+        controller.reconcile_once()
+        masters = cluster.list_pods(NodeType.MASTER)
+        assert len(masters) == 1
+        assert controller.phase == JobPhase.PENDING
+        # master running -> job running
+        controller.reconcile_once()
+        assert controller.phase == JobPhase.RUNNING
+        # master succeeds -> job succeeds
+        cluster.set_status(masters[0].name, NodeStatus.SUCCEEDED)
+        controller.reconcile_once()
+        assert controller.phase == JobPhase.SUCCEEDED
+
+    def test_master_relaunch_budget(self):
+        cluster = LocalCluster()
+        controller = ElasticJobController("j", cluster,
+                                          max_master_restarts=1)
+        controller.reconcile_once()
+        cluster.fail_pod(cluster.list_pods(NodeType.MASTER)[0].name)
+        controller.reconcile_once()   # relaunch 1
+        assert controller.master_restarts == 1
+        masters = [p for p in cluster.list_pods(NodeType.MASTER)
+                   if p.status != NodeStatus.DELETED]
+        assert len(masters) == 1
+        cluster.fail_pod(masters[0].name)
+        controller.reconcile_once()   # budget exhausted
+        assert controller.phase == JobPhase.FAILED
+
+    def test_scale_plan_relay_to_live_master(self):
+        import tests.test_job_manager as tj
+        from dlrover_tpu.master.job_master import JobMaster
+
+        cluster = LocalCluster()
+
+        def master_factory():
+            master = JobMaster(min_nodes=2, max_nodes=8,
+                               job_args=tj.make_job_args(workers=2),
+                               cluster=cluster, host="127.0.0.1")
+            master.prepare()
+            return master, master.addr
+
+        controller = ElasticJobController("j", cluster,
+                                          master_factory=master_factory)
+        controller.reconcile_once()   # creates master (real process-level)
+        master = controller._master_handle
+        assert tj.wait_until(
+            lambda: len(master.job_manager.get_running_workers()) == 2)
+        controller.submit_scale_plan(NodeType.WORKER, 3)
+        controller.reconcile_once()   # relays the plan over gRPC
+        assert tj.wait_until(
+            lambda: len(master.job_manager.get_running_workers()) == 3)
+        master.stop()

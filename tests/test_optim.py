@@ -1,0 +1,157 @@
+"""Optimizer family tests (parity: atorch optim/optimizers tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.optim import (
+    agd,
+    bf16_master,
+    row_sparse_adagrad,
+    wsam_value_and_grad,
+)
+
+
+def quadratic(params):
+    return jnp.sum((params - 1.5) ** 2)
+
+
+def run_opt(tx, params, loss_fn, steps=100, value_and_grad=None):
+    opt_state = tx.init(params)
+    vag = value_and_grad or jax.value_and_grad(loss_fn)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = vag(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state)
+    return params, float(loss)
+
+
+class TestAGD:
+    def test_converges_on_quadratic(self):
+        params = jnp.zeros(4)
+        params, loss = run_opt(agd(1e-1), params, quadratic)
+        assert loss < 1e-3
+        np.testing.assert_allclose(np.asarray(params), 1.5, atol=0.05)
+
+    def test_weight_decay_pulls_to_zero(self):
+        params = jnp.ones(4)
+        tx = agd(1e-1, weight_decay=10.0)
+        params, _ = run_opt(tx, params, quadratic, steps=200)
+        # heavy decay keeps params well below the unregularized optimum
+        assert float(jnp.abs(params).max()) < 1.0
+
+    def test_state_carries_grad_difference(self):
+        tx = agd(1e-2)
+        params = jnp.zeros(2)
+        state = tx.init(params)
+        g1 = jnp.array([1.0, 2.0])
+        _, state = tx.update(g1, state, params)
+        agd_state = state[0]
+        np.testing.assert_allclose(np.asarray(agd_state.prev_grad),
+                                   np.asarray(g1))
+
+
+class TestWSAM:
+    def test_gamma_zero_equals_plain_grad(self):
+        vag = wsam_value_and_grad(quadratic, rho=0.1, gamma=0.0)
+        params = jnp.array([0.0, 3.0])
+        loss, grads = vag(params)
+        _, plain = jax.value_and_grad(quadratic)(params)
+        np.testing.assert_allclose(np.asarray(grads), np.asarray(plain),
+                                   rtol=1e-6)
+
+    def test_converges_and_prefers_flat_minimum(self):
+        vag = wsam_value_and_grad(quadratic, rho=0.05, gamma=0.5)
+        params = jnp.zeros(4)
+        params, loss = run_opt(optax.sgd(0.1), params, quadratic,
+                               value_and_grad=vag)
+        assert loss < 1e-3
+
+    def test_invalid_gamma_rejected(self):
+        with pytest.raises(ValueError, match="gamma"):
+            wsam_value_and_grad(quadratic, gamma=1.0)
+
+
+class TestBF16Master:
+    def test_small_updates_accumulate_via_master(self):
+        # step small enough to vanish in bf16 rounding must still make
+        # progress through the fp32 master copy
+        params = jnp.ones(256, jnp.bfloat16) * 100.0
+        tx = bf16_master(optax.sgd(1e-4))
+        state = tx.init(params)
+        grads = jnp.ones_like(params)
+
+        @jax.jit
+        def step(params, state):
+            updates, state = tx.update(grads, state, params)
+            return optax.apply_updates(params, updates), state
+
+        for _ in range(200):
+            params, state = step(params, state)
+        master = state.master
+        # fp32 master moved by exactly 200 * 1e-4
+        np.testing.assert_allclose(np.asarray(master), 100.0 - 0.02,
+                                   rtol=1e-5)
+        assert params.dtype == jnp.bfloat16
+
+    def test_params_track_master_image(self):
+        params = jnp.ones(8, jnp.bfloat16)
+        tx = bf16_master(optax.sgd(0.5))
+        state = tx.init(params)
+        updates, state = tx.update(jnp.ones_like(params), state, params)
+        new_params = optax.apply_updates(params, updates)
+        np.testing.assert_allclose(
+            np.asarray(new_params, dtype=np.float32),
+            np.asarray(state.master.astype(jnp.bfloat16),
+                       dtype=np.float32))
+
+
+class TestRowSparseAdagrad:
+    def test_untouched_rows_bit_identical(self):
+        table = jnp.ones((8, 4))
+        tx = row_sparse_adagrad(0.1)
+        state = tx.init(table)
+        grads = jnp.zeros((8, 4)).at[2].set(1.0).at[5].set(-1.0)
+        updates, new_state = tx.update(grads, state)
+        new_table = optax.apply_updates(table, updates)
+        touched = [2, 5]
+        for row in range(8):
+            if row in touched:
+                assert not np.allclose(np.asarray(new_table[row]), 1.0)
+                assert not np.allclose(
+                    np.asarray(new_state.accumulator[row]), 0.1)
+            else:
+                np.testing.assert_array_equal(
+                    np.asarray(new_table[row]), np.float32(1.0))
+                np.testing.assert_array_equal(
+                    np.asarray(new_state.accumulator[row]),
+                    np.float32(0.1))
+
+    def test_embedding_convergence(self):
+        rng = np.random.default_rng(0)
+        table = jnp.asarray(rng.standard_normal((16, 4),
+                                                dtype=np.float32))
+        target = jnp.zeros((16, 4))
+        tx = row_sparse_adagrad(0.5)
+        state = tx.init(table)
+
+        @jax.jit
+        def step(table, state, rows):
+            def loss(t):
+                return jnp.sum((t[rows] - target[rows]) ** 2)
+
+            grads = jax.grad(loss)(table)
+            updates, state = tx.update(grads, state)
+            return optax.apply_updates(table, updates), state
+
+        for i in range(300):
+            rows = jnp.asarray(rng.integers(0, 16, (4,)))
+            table, state = step(table, state, rows)
+        assert float(jnp.abs(table).max()) < 0.2

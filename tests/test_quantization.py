@@ -1,0 +1,102 @@
+"""Quantization suite tests (parity: the CUDA kernels' pt_binding tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops.quantization import (
+    dequantize,
+    pack_int4,
+    quant_reduce,
+    quantize,
+    reference_quantize,
+    swizzled_quantize,
+    unpack_int4,
+    unswizzle_dequantize,
+)
+
+
+def data(shape=(4, 256), seed=0, scale=3.0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.standard_normal(shape, dtype=np.float32) * scale)
+
+
+class TestQuantizeDequantize:
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_pallas_matches_reference(self, bits):
+        x = data()
+        q, s = quantize(x, bits=bits, group_size=128)
+        q_ref, s_ref = reference_quantize(x, bits=bits, group_size=128)
+        np.testing.assert_array_equal(np.asarray(q), np.asarray(q_ref))
+        np.testing.assert_allclose(np.asarray(s), np.asarray(s_ref),
+                                   rtol=1e-6)
+
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_roundtrip_error_bounded(self, bits):
+        x = data()
+        q, s = quantize(x, bits=bits, group_size=128)
+        recon = dequantize(q, s, bits=bits)
+        assert recon.shape == x.shape
+        # error ≤ scale/2 per element (half a quantization step)
+        step = np.asarray(s).max()
+        err = np.abs(np.asarray(recon) - np.asarray(x)).max()
+        assert err <= step / 2 + 1e-6
+
+    def test_int8_exact_on_grid_values(self):
+        # values already on the quantization grid reconstruct exactly
+        scale = 0.5
+        x = jnp.arange(-127, 129, dtype=jnp.float32).reshape(2, 128) * scale
+        x = jnp.clip(x, -127 * scale, 127 * scale)
+        q, s = quantize(x, bits=8, group_size=128)
+        recon = dequantize(q, s)
+        np.testing.assert_allclose(np.asarray(recon), np.asarray(x),
+                                   atol=1e-5)
+
+    def test_zero_block_stays_zero(self):
+        x = jnp.zeros((2, 128))
+        q, s = quantize(x)
+        np.testing.assert_array_equal(np.asarray(q), 0)
+        np.testing.assert_array_equal(np.asarray(s), 0.0)
+        np.testing.assert_array_equal(np.asarray(dequantize(q, s)), 0.0)
+
+
+class TestInt4Packing:
+    def test_pack_unpack_roundtrip(self):
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.integers(-7, 8, (4, 64)), dtype=jnp.int8)
+        packed = pack_int4(q)
+        assert packed.shape == (4, 32)
+        np.testing.assert_array_equal(np.asarray(unpack_int4(packed)),
+                                      np.asarray(q))
+
+
+class TestSwizzle:
+    def test_swizzle_roundtrip(self):
+        x = data((8, 256), seed=2)
+        q, s = swizzled_quantize(x, partners=4, group_size=128)
+        assert q.shape[0] == 4
+        recon = unswizzle_dequantize(q, s, x.shape)
+        step = np.asarray(s).max()
+        assert np.abs(np.asarray(recon) - np.asarray(x)).max() <= step / 2 + 1e-6
+
+    def test_partner_chunks_cover_strided_elements(self):
+        # element i belongs to partner i % partners (interleaved layout)
+        flat = jnp.arange(16, dtype=jnp.float32)
+        q, s = swizzled_quantize(flat, partners=2, group_size=8)
+        recon_chunks = dequantize(q, s)
+        np.testing.assert_allclose(np.asarray(recon_chunks[0]),
+                                   np.arange(0, 16, 2), atol=0.1)
+
+
+class TestQuantReduce:
+    def test_reduces_to_sum(self):
+        chunks = jnp.stack([data((2, 128), seed=i) for i in range(4)])
+        qs, scales = jax.vmap(lambda c: quantize(c, group_size=128))(chunks)
+        q_sum, s_sum = quant_reduce(qs, scales, group_size=128)
+        recon = dequantize(q_sum, s_sum)
+        exact = np.asarray(chunks).sum(axis=0)
+        # quantization error of inputs + output, each ≤ step/2
+        tol = (np.asarray(scales).max() * 4 + np.asarray(s_sum).max()) / 2
+        assert np.abs(np.asarray(recon) - exact).max() <= tol + 1e-5
