@@ -160,6 +160,8 @@ class ElasticAgent:
         if count_against_budget:
             self._restart_count += 1
         self._spawn()
+        self._hang_event.clear()  # a stale flag must not re-kill the
+        # fresh worker (e.g. hang flagged, then crash-path restarted)
         if self._hang_detector is not None:
             self._hang_detector.reset()  # fresh compile grace period
 

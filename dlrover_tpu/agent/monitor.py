@@ -30,12 +30,16 @@ from dlrover_tpu.common.log import default_logger as logger
 
 
 def report_step(step: int, path: Optional[str] = None) -> None:
-    """Called from the TRAINING process each step (or every k steps)."""
+    """Called from the TRAINING process each step (or every k steps).
+    Atomic single-record write: readers only ever need the latest record,
+    and week-long jobs must not grow the file unboundedly."""
     path = path or os.environ.get(NodeEnv.METRICS_FILE, "")
     if not path:
         return
-    with open(path, "a") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         f.write(json.dumps({"step": int(step), "ts": time.time()}) + "\n")
+    os.replace(tmp, path)
 
 
 def _read_last_step(path: str) -> Optional[dict]:
@@ -78,7 +82,6 @@ class ResourceMonitor:
             import psutil
 
             cpu_percent = psutil.cpu_percent(interval=None)
-            process_rss = 0
             memory_mb = psutil.virtual_memory().used / (1 << 20)
         except ImportError:  # psutil is present in the image; belt+braces
             pass

@@ -436,3 +436,34 @@ class BrainJobMetricsRequest(Message):
 @dataclass
 class BrainJobMetrics(Message):
     records_json: str = ""       # JSON list
+
+
+# --------------------------------------------------------------------------
+# Coworker data plane (reference: atorch/service/coworker_data_service.py,
+# data_info_service.py, protos/coworker.proto)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class CoworkerBatch(Message):
+    """One preprocessed batch pushed from a CPU coworker pod."""
+
+    dataset_name: str = ""
+    payload: bytes = b""         # pickled batch (numpy trees)
+    producer_id: int = -1
+    seq: int = -1
+
+
+@dataclass
+class CoworkerBatchRequest(Message):
+    dataset_name: str = ""
+
+
+@dataclass
+class CoworkerInfo(Message):
+    """Queue depth/capacity so producers can back off (data_info_service)."""
+
+    dataset_name: str = ""
+    queued: int = 0
+    capacity: int = 0
+    finished: bool = False

@@ -1,0 +1,131 @@
+"""Coworker data plane: CPU pods push preprocessed batches to TPU pods.
+
+Capability parity: atorch/service/coworker_data_service.py +
+data_info_service.py + rpc_clients.py (gRPC services connecting GPU pods
+to CPU "coworker" preprocessing pods; protos/coworker.proto) and
+CoworkerDataset (data/coworker_dataset.py:13). Same 2-RPC comm layer as
+the control plane; same-host coworkers should prefer ShmDataContext (no
+serialization), this service is the cross-host path.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import threading
+from typing import Any, Iterator, Optional
+
+import grpc
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import MasterStub, build_channel, build_server
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class CoworkerDataService:
+    """Runs INSIDE the trainer process; coworkers dial it and push
+    batches. Bounded queue: producers see back-pressure via CoworkerInfo
+    and blocked reports."""
+
+    def __init__(self, capacity: int = 64, port: int = 0,
+                 host: str = "0.0.0.0"):
+        self._queues: dict = {}
+        self._capacity = capacity
+        self._finished = False
+        self._lock = threading.Lock()
+        self._server, self.port = build_server(
+            self._get_bytes, self._report_bytes, port=port, host=host)
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("coworker data service on port %d", self.port)
+
+    def stop(self, grace_s: float = 0.5) -> None:
+        self._server.stop(grace_s)
+
+    def _queue_for(self, dataset: str) -> "queue.Queue":
+        with self._lock:
+            if dataset not in self._queues:
+                self._queues[dataset] = queue.Queue(self._capacity)
+            return self._queues[dataset]
+
+    # -- wire ------------------------------------------------------------
+    def _get_bytes(self, payload: bytes,
+                   context: grpc.ServicerContext) -> bytes:
+        request = msg.deserialize_message(payload)
+        if isinstance(request, msg.CoworkerBatchRequest):
+            q = self._queue_for(request.dataset_name)
+            return msg.serialize_message(msg.CoworkerInfo(
+                dataset_name=request.dataset_name,
+                queued=q.qsize(), capacity=self._capacity,
+                finished=self._finished,
+            ))
+        return msg.serialize_message(
+            msg.Response(success=False, reason="unknown request"))
+
+    def _report_bytes(self, payload: bytes,
+                      context: grpc.ServicerContext) -> bytes:
+        request = msg.deserialize_message(payload)
+        if isinstance(request, msg.CoworkerBatch):
+            try:
+                self._queue_for(request.dataset_name).put(
+                    request.payload, timeout=20.0)
+                return msg.serialize_message(msg.Response(success=True))
+            except queue.Full:
+                return msg.serialize_message(msg.Response(
+                    success=False, reason="queue full"))
+        return msg.serialize_message(
+            msg.Response(success=False, reason="unknown request"))
+
+    # -- trainer-side consumption ----------------------------------------
+    def mark_finished(self) -> None:
+        self._finished = True
+
+    def batches(self, dataset_name: str = "default",
+                timeout_s: Optional[float] = 60.0) -> Iterator[Any]:
+        import time
+
+        q = self._queue_for(dataset_name)
+        last_progress = time.time()
+        while True:
+            try:
+                payload = q.get(timeout=0.2)
+                last_progress = time.time()
+                yield pickle.loads(payload)
+            except queue.Empty:
+                if self._finished:
+                    return
+                if (timeout_s is not None
+                        and time.time() - last_progress > timeout_s):
+                    raise TimeoutError(
+                        f"no coworker batch for dataset "
+                        f"{dataset_name!r} in {timeout_s:.0f}s")
+
+
+class CoworkerClient:
+    """Runs in the CPU coworker process; pushes batches with back-off."""
+
+    def __init__(self, trainer_addr: str, producer_id: int = 0,
+                 timeout_s: float = 30.0):
+        self._stub = MasterStub(build_channel(trainer_addr))
+        self._producer_id = producer_id
+        self._timeout_s = timeout_s
+        self._seq = 0
+
+    def queue_info(self, dataset_name: str = "default") -> msg.CoworkerInfo:
+        raw = self._stub.get(msg.serialize_message(
+            msg.CoworkerBatchRequest(dataset_name=dataset_name)),
+            timeout=self._timeout_s)
+        return msg.deserialize_message(raw)
+
+    def push_batch(self, batch: Any, dataset_name: str = "default") -> bool:
+        self._seq += 1
+        raw = self._stub.report(msg.serialize_message(msg.CoworkerBatch(
+            dataset_name=dataset_name,
+            payload=pickle.dumps(batch,
+                                 protocol=pickle.HIGHEST_PROTOCOL),
+            producer_id=self._producer_id,
+            seq=self._seq,
+        )), timeout=self._timeout_s)
+        response = msg.deserialize_message(raw)
+        return bool(getattr(response, "success", False))

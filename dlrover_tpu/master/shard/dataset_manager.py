@@ -43,6 +43,8 @@ class DatasetShardCheckpoint:
     completed_records: int = 0
     # lazy-split huge datasets: records already materialized this epoch
     sub_epoch_offset: int = 0
+    # manager-specific state (e.g. the streaming watermark)
+    extra: dict = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps({
@@ -51,6 +53,7 @@ class DatasetShardCheckpoint:
             "epoch": self.epoch,
             "completed_records": self.completed_records,
             "sub_epoch_offset": self.sub_epoch_offset,
+            "extra": self.extra,
         })
 
     @classmethod
@@ -62,6 +65,7 @@ class DatasetShardCheckpoint:
             epoch=d["epoch"],
             completed_records=d.get("completed_records", 0),
             sub_epoch_offset=d.get("sub_epoch_offset", 0),
+            extra=d.get("extra", {}),
         )
 
 

@@ -1,0 +1,133 @@
+"""Mixtral-style MoE Llama: sparse expert MLPs in the Llama skeleton.
+
+Capability parity: the reference's MoE model path (atorch modules/moe
+MOELayer injected into transformer blocks via moe/inject.py) — here a
+first-class model family: Llama attention + RMSNorm with each block's MLP
+replaced by the expert-parallel MoELayer (dlrover_tpu/parallel/moe.py).
+Router aux losses are sown into the 'losses' collection;
+`moe_cross_entropy_loss` folds them into the objective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models.llama import (
+    Attention,
+    LlamaConfig,
+    RMSNorm,
+    _logical,
+    cross_entropy_loss,
+)
+from dlrover_tpu.ops.remat import resolve_remat_policy
+from dlrover_tpu.parallel.moe import MoEConfig, MoELayer, moe_aux_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaMoEConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    def moe_config(self) -> MoEConfig:
+        return MoEConfig(
+            num_experts=self.num_experts,
+            top_k=self.top_k,
+            hidden_size=self.hidden_size,
+            expert_intermediate=self.intermediate_size,
+            capacity_factor=self.capacity_factor,
+            aux_loss_weight=self.aux_loss_weight,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+
+    @classmethod
+    def mixtral_tiny(cls, **kw) -> "LlamaMoEConfig":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq_len", 128)
+        return cls(hidden_size=64, intermediate_size=128, num_layers=2,
+                   num_heads=4, num_kv_heads=2, num_experts=4, top_k=2,
+                   **kw)
+
+    def param_count(self) -> int:
+        dense = super().param_count()
+        # each layer's single MLP becomes num_experts experts + a router
+        per_layer_mlp = 3 * self.hidden_size * self.intermediate_size
+        moe_mlp = (2 * self.hidden_size * self.intermediate_size
+                   * self.num_experts
+                   + self.hidden_size * self.num_experts)
+        return dense + self.num_layers * (moe_mlp - per_layer_mlp)
+
+    def active_param_count(self) -> int:
+        """Params touched per token (the MoE efficiency headline)."""
+        dense = super().param_count()
+        per_layer_mlp = 3 * self.hidden_size * self.intermediate_size
+        active_mlp = (2 * self.hidden_size * self.intermediate_size
+                      * self.top_k
+                      + self.hidden_size * self.num_experts)
+        return dense + self.num_layers * (active_mlp - per_layer_mlp)
+
+
+class MoEDecoderBlock(nn.Module):
+    config: LlamaMoEConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
+            positions,
+        )
+        x = x + MoELayer(cfg.moe_config(), name="moe")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="moe_norm")(x)
+        )
+        return x
+
+
+class LlamaMoE(nn.Module):
+    """Decoder-only MoE LM (Mixtral shape): call with mutable=['losses']
+    to collect router aux losses."""
+
+    config: LlamaMoEConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        cfg = self.config
+        embed = self.param(
+            "embed",
+            _logical(nn.initializers.normal(0.02), "vocab", "embed"),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype,
+        )
+        x = embed.astype(cfg.dtype)[tokens]
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[-1]), tokens.shape)
+        block_cls = MoEDecoderBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                MoEDecoderBlock, static_argnums=(),
+                policy=resolve_remat_policy(cfg.remat_policy),
+            )
+        for layer in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"layer_{layer}")(x, positions)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        head = self.param(
+            "lm_head",
+            _logical(nn.initializers.normal(0.02), "embed", "vocab"),
+            (cfg.hidden_size, cfg.vocab_size), cfg.param_dtype,
+        )
+        return jnp.dot(x, head.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def moe_cross_entropy_loss(model: LlamaMoE, params: Any,
+                           tokens: jax.Array,
+                           targets: jax.Array) -> jax.Array:
+    """Cross entropy + router aux losses in one scalar."""
+    logits, mutables = model.apply({"params": params}, tokens,
+                                   mutable=["losses"])
+    return cross_entropy_loss(logits, targets) + moe_aux_loss(mutables)

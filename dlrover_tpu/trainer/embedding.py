@@ -1,0 +1,135 @@
+"""Elastic embedding training — the parameter-server path, TPU-reframed.
+
+Capability parity: the reference's TF/PS elastic training (EstimatorExecutor
+trainer/tensorflow/executor/estimator_executor.py:52, PS failover
+tensorflow_failover.py:33, ElasticPsService cluster-version arbitration
+master/elastic_training/elastic_ps.py:18). SURVEY.md §7 calls for the
+idiomatic TPU reframing: there are no parameter-server processes — the
+embedding table is a sharded array over the fsdp axis, updated row-sparsely
+(dlrover_tpu/optim/sparse.py), and "PS failover" becomes cluster-version
+arbitration + checkpoint-restore of the table, reusing the master's
+ElasticPsService + SyncService machinery unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.common.constants import MeshAxis
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingConfig:
+    vocab_size: int
+    embed_dim: int
+    combiner: str = "none"      # "none" | "mean" | "sum" (multi-hot bags)
+    param_dtype: Any = jnp.float32
+
+
+class ShardedEmbedding(nn.Module):
+    """Embedding table sharded over the fsdp axis by rows (the PS shard
+    dimension). Lookup gathers ride XLA's all-to-all across shards."""
+
+    cfg: EmbeddingConfig
+
+    @nn.compact
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        table = self.param(
+            "table",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.01), ("embed_rows", "embed_cols")),
+            (self.cfg.vocab_size, self.cfg.embed_dim),
+            self.cfg.param_dtype,
+        )
+        out = jnp.take(table, ids, axis=0)
+        if self.cfg.combiner in ("mean", "sum") and ids.ndim >= 2:
+            # bag lookup: (..., multi_hot, dim) → (..., dim)
+            reduce = jnp.mean if self.cfg.combiner == "mean" else jnp.sum
+            out = reduce(out, axis=-2)
+        return out
+
+
+# logical-axis rules for the PS path: rows over fsdp (the "server shard"
+# dim), columns replicated
+EMBEDDING_RULES = [
+    ("embed_rows", MeshAxis.FSDP),
+    ("embed_cols", None),
+]
+
+
+class ElasticEmbeddingTrainer:
+    """PS-style training loop core: sparse embedding + dense tower.
+
+    Version arbitration contract (reference elastic_ps.py): workers call
+    `client.update_cluster_version("local", v)` after restoring and train
+    only once `get_cluster_version("global") >= local` — the master's
+    ElasticPsService (master/sync_service.py) decides the global version.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        embedding: ShardedEmbedding,
+        dense_apply,                   # (dense_params, emb) -> loss inputs
+        loss_fn,
+        embed_tx: Optional[optax.GradientTransformation] = None,
+        dense_tx: Optional[optax.GradientTransformation] = None,
+    ):
+        from dlrover_tpu.optim.sparse import row_sparse_adagrad
+
+        self.mesh = mesh
+        self.embedding = embedding
+        self.dense_apply = dense_apply
+        self.loss_fn = loss_fn
+        # the PS-analog split: sparse optimizer for the table, dense
+        # optimizer for everything else (exactly the reference's
+        # sparse-PS / dense-worker split)
+        self.embed_tx = embed_tx or row_sparse_adagrad(0.05)
+        self.dense_tx = dense_tx or optax.adam(1e-3)
+
+    def init(self, rng: jax.Array, sample_ids: jax.Array,
+             dense_params: Any) -> Tuple[Any, Any, Any]:
+        from dlrover_tpu.parallel.sharding import mesh_shardings
+
+        abstract = jax.eval_shape(
+            lambda: self.embedding.init(rng, sample_ids))
+        shardings = mesh_shardings(abstract, self.mesh, EMBEDDING_RULES)
+        variables = jax.jit(
+            lambda: nn.unbox(self.embedding.init(rng, sample_ids)),
+            out_shardings=shardings)()
+        embed_params = variables["params"]
+        return (embed_params, self.embed_tx.init(embed_params),
+                self.dense_tx.init(dense_params))
+
+    def build_step(self):
+        embedding = self.embedding
+        dense_apply = self.dense_apply
+        loss_fn = self.loss_fn
+        embed_tx, dense_tx = self.embed_tx, self.dense_tx
+
+        @jax.jit
+        def step(embed_params, embed_opt, dense_params, dense_opt, ids,
+                 labels):
+            def compute(embed_p, dense_p):
+                emb = embedding.apply({"params": embed_p}, ids)
+                preds = dense_apply(dense_p, emb)
+                return loss_fn(preds, labels)
+
+            loss, (g_embed, g_dense) = jax.value_and_grad(
+                compute, argnums=(0, 1))(embed_params, dense_params)
+            eu, embed_opt = embed_tx.update(g_embed, embed_opt,
+                                            embed_params)
+            embed_params = optax.apply_updates(embed_params, eu)
+            du, dense_opt = dense_tx.update(g_dense, dense_opt,
+                                            dense_params)
+            dense_params = optax.apply_updates(dense_params, du)
+            return embed_params, embed_opt, dense_params, dense_opt, loss
+
+        return step
