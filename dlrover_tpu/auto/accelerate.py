@@ -144,9 +144,37 @@ def lower(context: ModelContext) -> AccelerateResult:
     sample = context.infer_sample_batch(micro)
 
     if plan.pipeline_stages > 1:
-        raise NotImplementedError(
-            "pipeline lowering arrives with dlrover_tpu.parallel.pipeline; "
-            "use mixed_parallel without pipe for now")
+        from dlrover_tpu.models.llama import LlamaConfig
+        from dlrover_tpu.trainer.pipeline_trainer import (
+            build_pipeline_trainer,
+        )
+
+        cfg = context.model_config()
+        if not isinstance(cfg, LlamaConfig):
+            raise NotImplementedError(
+                "pipeline lowering needs a stacked-decoder model "
+                "(LlamaConfig family); for custom models call "
+                "dlrover_tpu.parallel.pipeline.pipeline_apply directly")
+        if plan.fsdp or plan.tensor_parallel:
+            logger.warning(
+                "pipeline lowering does not yet shard stage-internal "
+                "params: the requested fsdp/tensor dims apply only to the "
+                "batch; expect replicated weights within each stage")
+        if plan.global_batch:
+            # the accumulation geometry IS the microbatch stream: the
+            # user's global batch is authoritative (accum × micro rows)
+            num_micro = accum
+        else:
+            num_micro = max(plan.accum_steps, 2 * plan.pipeline_stages)
+        trainer = build_pipeline_trainer(
+            cfg, context.make_optimizer(), mesh,
+            num_microbatches=num_micro, micro_batch=micro,
+            seq_len=np.asarray(sample).shape[-1],
+            loss_fn=context.loss_fn, remat=plan.remat,
+        )
+        return AccelerateResult(trainer=trainer, mesh=mesh,
+                                model=context.model, strategy=[],
+                                context=context)
 
     trainer = build_trainer(
         context.model,

@@ -194,20 +194,27 @@ class MLP(nn.Module):
                      ("mlp", "embed"))(nn.silu(gate) * up)
 
 
+ACT_AXES = ("act_batch", "act_seq", "act_embed")
+
+
 class DecoderBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.config
+        # pin the activation layout so SPMD never round-trips the
+        # residual stream between layouts (constraint is a no-op off-mesh)
+        x = nn.with_logical_constraint(x, ACT_AXES)
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
             positions,
         )
+        x = nn.with_logical_constraint(x, ACT_AXES)
         x = x + MLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x)
         )
-        return x
+        return nn.with_logical_constraint(x, ACT_AXES)
 
 
 class Llama(nn.Module):

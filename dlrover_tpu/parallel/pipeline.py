@@ -79,12 +79,17 @@ def pipeline_apply(
     inputs: jax.Array,
     axis: str = MeshAxis.PIPE,
     remat: bool = False,
+    batch_axes=None,
 ) -> jax.Array:
     """Run `inputs` (num_microbatches, micro, ...) through the pipeline.
 
     stacked_params: pytree whose leaves have a leading stage dim of size
     mesh.shape[axis]; stage_fn(params_one_stage, x) -> y with y.shape ==
     x.shape (uniform-stage contract, same as GPipe splits).
+
+    batch_axes: mesh axes the micro (row) dim is sharded over — PP×DP
+    composition: each data replica pipelines only its row shard. None =
+    replicated rows (pure PP).
     """
     num_stages = mesh.shape[axis]
     num_microbatches = inputs.shape[0]
@@ -99,11 +104,12 @@ def pipeline_apply(
             num_microbatches=num_microbatches)
 
     params_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    data_spec = P(None, batch_axes) if batch_axes is not None else P()
     piped = shard_map(
         body,
         mesh=mesh,
-        in_specs=(params_spec, P()),
-        out_specs=P(),
+        in_specs=(params_spec, data_spec),
+        out_specs=data_spec,
         check_vma=False,
     )
     return piped(stacked_params, inputs)

@@ -29,6 +29,12 @@ DEFAULT_RULES: List[Tuple[str, Optional[Any]]] = [
     ("embed", MeshAxis.FSDP),
     ("expert", MeshAxis.EXPERT),
     ("norm", None),
+    # activation layout (consumed by nn.with_logical_constraint in the
+    # models): batch over the joint dp axes, seq/embed unsharded by
+    # default (the sequence axis claims act_seq under SP)
+    ("act_batch", (MeshAxis.DATA, MeshAxis.FSDP)),
+    ("act_seq", MeshAxis.SEQUENCE),
+    ("act_embed", None),
 ]
 
 

@@ -1,0 +1,179 @@
+"""Pipelined transformer trainer: GPipe over stacked decoder layers.
+
+Capability parity: atorch's pipeline-parallel training path (PiPPy
+compile → stages → driver, distributed_pippy_compiler.py:378; DeepSpeed
+3D alternative). TPU re-design (scan-over-layers lineage): decoder-layer
+params are stacked (num_stages, layers_per_stage, ...) with the stage dim
+sharded over the `pipe` mesh axis; the forward runs embedding (replicated
+compute), then `pipeline_apply` streams microbatch row-shards through the
+stages with ppermute (each data replica pipelines its own rows — PP×DP),
+then the LM head. Same init/step/shard_batch surface as build_trainer.
+
+Current scope: stage-internal params are not additionally TP/FSDP-sharded
+(lowering warns when those were requested together with pipe); the
+embedding/head are replicated.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.common.constants import MeshAxis
+from dlrover_tpu.models.llama import DecoderBlock, LlamaConfig
+from dlrover_tpu.parallel.pipeline import pipeline_apply
+from dlrover_tpu.trainer.train_step import TrainState
+
+_BATCH_AXES = (MeshAxis.DATA, MeshAxis.FSDP)
+
+
+def _init_llama_pipeline_params(cfg: LlamaConfig, num_stages: int,
+                                rng: jax.Array, sample_seq: int):
+    """Params: embed (V,H), stacked block params with leading
+    (num_stages, layers_per_stage, ...), final norm + head."""
+    if cfg.num_layers % num_stages:
+        raise ValueError(f"{cfg.num_layers} layers not divisible by "
+                         f"{num_stages} stages")
+    per_stage = cfg.num_layers // num_stages
+    block = DecoderBlock(cfg)
+    x = jnp.zeros((1, sample_seq, cfg.hidden_size), cfg.dtype)
+    positions = jnp.zeros((1, sample_seq), jnp.int32)
+    rngs = jax.random.split(rng, cfg.num_layers + 2)
+
+    def init_one(layer_rng):
+        return nn.unbox(block.init(layer_rng, x, positions))["params"]
+
+    stacked = jax.vmap(init_one)(rngs[:cfg.num_layers])
+    stacked = jax.tree.map(
+        lambda leaf: leaf.reshape((num_stages, per_stage)
+                                  + leaf.shape[1:]), stacked)
+    embed = jax.random.normal(rngs[-2],
+                              (cfg.vocab_size, cfg.hidden_size),
+                              cfg.param_dtype) * 0.02
+    head = jax.random.normal(rngs[-1],
+                             (cfg.hidden_size, cfg.vocab_size),
+                             cfg.param_dtype) * 0.02
+    norm = jnp.ones((cfg.hidden_size,), cfg.param_dtype)
+    return {"embed": embed, "stages": stacked, "final_norm": norm,
+            "lm_head": head}
+
+
+def _stage_fn_factory(cfg: LlamaConfig):
+    block = DecoderBlock(cfg)
+
+    def stage_fn(stage_params, x):
+        # x: (micro, seq, hidden); stage_params leaves: (per_stage, ...)
+        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+
+        def one_layer(h, layer_params):
+            return block.apply({"params": layer_params}, h, positions), None
+
+        x, _ = lax.scan(one_layer, x, stage_params)
+        return x
+
+    return stage_fn
+
+
+class PipelinedLlamaTrainer:
+    """Same surface as ShardedTrainer (init/step/shard_batch)."""
+
+    def __init__(self, cfg: LlamaConfig, tx: optax.GradientTransformation,
+                 mesh: Mesh, num_microbatches: int, micro_batch: int,
+                 seq_len: int, loss_fn, remat: bool = False):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.num_stages = mesh.shape[MeshAxis.PIPE]
+        self.num_microbatches = num_microbatches
+        self.micro_batch = micro_batch
+        self.accum_steps = num_microbatches  # microbatches play this role
+        self.seq_len = seq_len
+        self._tx = tx
+        self._loss_fn = loss_fn
+        self._remat = remat
+        # batch arrays: (M, micro, seq) with micro rows over the dp axes
+        self.batch_sharding = NamedSharding(mesh, P(None, _BATCH_AXES))
+        self.state_shardings = None
+        self._step = None
+
+    # -- params ---------------------------------------------------------
+    def _sharding_for_path(self, path) -> NamedSharding:
+        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if "stages" in keys:
+            return NamedSharding(self.mesh, P(MeshAxis.PIPE))
+        return NamedSharding(self.mesh, P())
+
+    def init(self, rng: jax.Array) -> TrainState:
+        def make_state(rng):
+            params = _init_llama_pipeline_params(
+                self.cfg, self.num_stages, rng, self.seq_len)
+            return TrainState(step=jnp.zeros((), jnp.int32),
+                              params=params,
+                              opt_state=self._tx.init(params))
+
+        abstract = jax.eval_shape(make_state, rng)
+        # stage tensors (and their optimizer moments, which mirror the
+        # param tree) shard over pipe; everything else replicated
+        self.state_shardings = jax.tree_util.tree_map_with_path(
+            lambda path, _: self._sharding_for_path(path), abstract)
+        # jit with out_shardings: nothing ever materializes replicated
+        return jax.jit(make_state,
+                       out_shardings=self.state_shardings)(rng)
+
+    # -- data -----------------------------------------------------------
+    def shard_batch(self, tokens, targets):
+        m, micro = self.num_microbatches, self.micro_batch
+        tokens = tokens.reshape(m, micro, *tokens.shape[1:])
+        targets = targets.reshape(m, micro, *targets.shape[1:])
+        put = lambda x: jax.device_put(x, self.batch_sharding)
+        return put(tokens), put(targets)
+
+    # -- step -----------------------------------------------------------
+    def _forward(self, params, tokens):
+        cfg = self.cfg
+        x = params["embed"].astype(cfg.dtype)[tokens]  # (M, mb, S, H)
+        out = pipeline_apply(
+            self.mesh, _stage_fn_factory(cfg), params["stages"],
+            x, remat=self._remat, batch_axes=_BATCH_AXES)
+        from dlrover_tpu.ops.norms import reference_rms_norm
+
+        out = reference_rms_norm(out, params["final_norm"]
+                                 .astype(jnp.float32), cfg.rms_norm_eps)
+        logits = jnp.dot(out.astype(cfg.dtype),
+                         params["lm_head"].astype(cfg.dtype))
+        return logits.astype(jnp.float32)
+
+    def step(self, state: TrainState, tokens, targets):
+        if self._step is None:
+            loss_fn = self._loss_fn
+            tx = self._tx
+
+            def train_step(state, tokens, targets):
+                def compute(params):
+                    logits = self._forward(params, tokens)
+                    return loss_fn(
+                        logits.reshape(-1, *logits.shape[2:]),
+                        targets.reshape(-1, *targets.shape[2:]))
+
+                loss, grads = jax.value_and_grad(compute)(state.params)
+                updates, opt_state = tx.update(grads, state.opt_state,
+                                               state.params)
+                params = optax.apply_updates(state.params, updates)
+                return TrainState(step=state.step + 1, params=params,
+                                  opt_state=opt_state), {"loss": loss}
+
+            self._step = jax.jit(train_step, donate_argnums=(0,))
+        return self._step(state, tokens, targets)
+
+
+def build_pipeline_trainer(cfg: LlamaConfig,
+                           tx: optax.GradientTransformation,
+                           mesh: Mesh, num_microbatches: int,
+                           micro_batch: int, seq_len: int, loss_fn,
+                           remat: bool = False) -> PipelinedLlamaTrainer:
+    return PipelinedLlamaTrainer(cfg, tx, mesh, num_microbatches,
+                                 micro_batch, seq_len, loss_fn,
+                                 remat=remat)

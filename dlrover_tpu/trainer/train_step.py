@@ -107,6 +107,13 @@ def build_trainer(
     )
 
     def _train_step(state: TrainState, tokens, targets):
+        # activation logical-constraints in the models resolve through
+        # these rules (no-ops without this context); with-block so a
+        # trace-time exception never leaks flax's global rules stack
+        with nn.logical_axis_rules(rules):
+            return _train_step_body(state, tokens, targets)
+
+    def _train_step_body(state: TrainState, tokens, targets):
         params = state.params
 
         def micro_step(carry, micro):
