@@ -42,9 +42,11 @@ class MasterClient:
     _singleton: Optional["MasterClient"] = None
 
     def __init__(self, master_addr: str, node_id: int = 0,
-                 node_rank: Optional[int] = None, timeout_s: float = 30.0):
+                 node_rank: Optional[int] = None, timeout_s: float = 30.0,
+                 node_type: str = ""):
         self.master_addr = master_addr
         self.node_id = node_id
+        self.node_type = node_type
         self.node_rank = node_rank if node_rank is not None else node_id
         self._timeout_s = timeout_s
         self._channel = build_channel(master_addr)
@@ -198,7 +200,8 @@ class MasterClient:
 
     def report_heartbeat(self) -> bool:
         return self._report(msg.NodeHeartbeat(
-            node_id=self.node_id, timestamp=time.time())).success
+            node_id=self.node_id, node_type=self.node_type,
+            timestamp=time.time())).success
 
     def report_failure(self, error_data: str, level: str,
                        restart_count: int = 0) -> bool:

@@ -83,6 +83,7 @@ class NodeEnv:
 
     MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
     NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_TYPE = "DLROVER_TPU_NODE_TYPE"
     NODE_RANK = "DLROVER_TPU_NODE_RANK"
     NODE_NUM = "DLROVER_TPU_NODE_NUM"
     JOB_NAME = "DLROVER_TPU_JOB_NAME"

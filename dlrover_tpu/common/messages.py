@@ -289,6 +289,7 @@ class NodeResourceStats(Message):
 @dataclass
 class NodeHeartbeat(Message):
     node_id: int = -1
+    node_type: str = ""
     timestamp: float = 0.0
 
 

@@ -268,8 +268,20 @@ class JobManager:
         if stats.chip_stats:
             node.used_resource.chips = len(stats.chip_stats)
 
-    def collect_heartbeat(self, node_id: int, timestamp: float) -> None:
+    def collect_heartbeat(self, node_id: int, timestamp: float,
+                          node_type: str = "") -> None:
+        """Refresh one node's heartbeat. node_type disambiguates groups that
+        reuse ids (a worker beat must not refresh a chief/evaluator with the
+        same id, which would weaken all_running_node_hanged)."""
         with self._lock:
+            if node_type:
+                by_id = self._nodes.get(node_type, {})
+                if node_id in by_id:
+                    by_id[node_id].heartbeat_time = timestamp
+                    return
+                # typed miss (old client, or node adopted under another
+                # group after a master restart): fall through to the
+                # untyped scan rather than drop the liveness signal
             for by_id in self._nodes.values():
                 if node_id in by_id:
                     by_id[node_id].heartbeat_time = timestamp

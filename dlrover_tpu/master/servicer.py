@@ -177,8 +177,9 @@ class MasterServicer:
                 self.metric_collector.collect_node_stats(request)
         elif isinstance(request, msg.NodeHeartbeat):
             if self.job_manager is not None:
-                self.job_manager.collect_heartbeat(request.node_id,
-                                                   request.timestamp)
+                self.job_manager.collect_heartbeat(
+                    request.node_id, request.timestamp,
+                    node_type=request.node_type)
         elif isinstance(request, msg.NodeFailureReport):
             logger.warning("node %d failure (level=%s): %s",
                            request.node_id, request.level,

@@ -33,6 +33,7 @@ class LlamaMoEConfig(LlamaConfig):
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
+    jitter_noise: float = 0.0
     aux_loss_weight: float = 0.01
 
     def moe_config(self) -> MoEConfig:
@@ -42,6 +43,7 @@ class LlamaMoEConfig(LlamaConfig):
             hidden_size=self.hidden_size,
             expert_intermediate=self.intermediate_size,
             capacity_factor=self.capacity_factor,
+            jitter_noise=self.jitter_noise,
             aux_loss_weight=self.aux_loss_weight,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -76,6 +78,7 @@ class LlamaMoEConfig(LlamaConfig):
 
 class MoEDecoderBlock(nn.Module):
     config: LlamaMoEConfig
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, x, positions):
@@ -84,7 +87,8 @@ class MoEDecoderBlock(nn.Module):
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
             positions,
         )
-        x = x + MoELayer(cfg.moe_config(), name="moe")(
+        x = x + MoELayer(cfg.moe_config(),
+                         deterministic=self.deterministic, name="moe")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="moe_norm")(x)
         )
         return x
@@ -92,9 +96,11 @@ class MoEDecoderBlock(nn.Module):
 
 class LlamaMoE(nn.Module):
     """Decoder-only MoE LM (Mixtral shape): call with mutable=['losses']
-    to collect router aux losses."""
+    to collect router aux losses. Construct with deterministic=False for
+    training so the train capacity factor and router jitter apply."""
 
     config: LlamaMoEConfig
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
@@ -114,7 +120,8 @@ class LlamaMoE(nn.Module):
                 policy=resolve_remat_policy(cfg.remat_policy),
             )
         for layer in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"layer_{layer}")(x, positions)
+            x = block_cls(cfg, deterministic=self.deterministic,
+                          name=f"layer_{layer}")(x, positions)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
         head = self.param(
             "lm_head",

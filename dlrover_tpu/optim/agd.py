@@ -21,8 +21,7 @@ import optax
 class AGDState(NamedTuple):
     count: chex.Array
     mu: optax.Updates       # first moment
-    nu: optax.Updates       # gradient-difference second moment
-    prev_grad: optax.Updates
+    nu: optax.Updates       # moment-difference second moment
 
 
 def agd(
@@ -38,17 +37,20 @@ def agd(
             count=jnp.zeros((), jnp.int32),
             mu=jax.tree.map(jnp.zeros_like, params),
             nu=jax.tree.map(jnp.zeros_like, params),
-            prev_grad=jax.tree.map(jnp.zeros_like, params),
         )
 
     def update_fn(updates, state, params=None):
         count = state.count + 1
-        # first step: difference vs 0 would inflate nu; use the gradient
-        # itself (Adam-like bootstrap), then switch to differences
-        diff = jax.tree.map(
-            lambda g, pg: jnp.where(count == 1, g, g - pg),
-            updates, state.prev_grad)
         mu = optax.incremental_update(updates, state.mu, 1 - b1)
+        # The preconditioner accumulates the squared difference of
+        # BIAS-CORRECTED first moments, mu_hat_t - mu_hat_{t-1} (the paper's
+        # stepwise gradient difference is on the smoothed gradient). At
+        # count==1 the previous moment is zero, so the diff degenerates to
+        # the raw gradient — the Adam-like bootstrap falls out naturally.
+        prev_bc = jnp.where(count == 1, 1.0, 1.0 - b1 ** (count - 1))
+        cur_bc = 1.0 - b1 ** count
+        diff = jax.tree.map(
+            lambda m, pm: m / cur_bc - pm / prev_bc, mu, state.mu)
         nu = jax.tree.map(
             lambda n, d: b2 * n + (1 - b2) * jnp.square(d),
             state.nu, diff)
@@ -64,8 +66,7 @@ def agd(
                 raise ValueError("weight_decay requires params")
             new_updates = jax.tree.map(
                 lambda u, p: u + weight_decay * p, new_updates, params)
-        return new_updates, AGDState(count=count, mu=mu, nu=nu,
-                                     prev_grad=updates)
+        return new_updates, AGDState(count=count, mu=mu, nu=nu)
 
     tx = optax.GradientTransformation(init_fn, update_fn)
     return optax.chain(

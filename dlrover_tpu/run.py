@@ -109,8 +109,9 @@ def run(args: argparse.Namespace) -> int:
     else:
         entrypoint = [sys.executable, args.entrypoint] + entrypoint
 
+    node_type = os.environ.get(NodeEnv.NODE_TYPE, "worker")
     client = MasterClient(master_addr, node_id=args.node_rank,
-                          node_rank=args.node_rank)
+                          node_rank=args.node_rank, node_type=node_type)
     devices = args.devices_per_node or _detect_devices()
     spec = WorkerSpec(
         entrypoint=entrypoint,

@@ -69,7 +69,12 @@ class K8sApi:
             method=method,
         )
         req.add_header("Accept", "application/json")
-        req.add_header("Content-Type", "application/json")
+        # k8s rejects PATCH with a plain JSON media type (415); it requires
+        # one of the patch content types (we use merge-patch).
+        if method == "PATCH":
+            req.add_header("Content-Type", "application/merge-patch+json")
+        else:
+            req.add_header("Content-Type", "application/json")
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
         with urllib.request.urlopen(req, timeout=timeout,
@@ -184,6 +189,7 @@ def build_pod_manifest(
     env = [
         {"name": NodeEnv.MASTER_ADDR, "value": master_addr},
         {"name": NodeEnv.NODE_ID, "value": str(node_id)},
+        {"name": NodeEnv.NODE_TYPE, "value": node_type},
         {"name": NodeEnv.NODE_RANK, "value": str(rank_index)},
         {"name": NodeEnv.NODE_NUM, "value": str(node_num)},
         {"name": NodeEnv.JOB_NAME, "value": job_name},
@@ -247,8 +253,15 @@ def pod_to_fields(pod: Dict[str, Any]) -> Dict[str, Any]:
         term = (cs.get("state", {}) or {}).get("terminated")
         if term:
             reason = term.get("reason", "")
-            if term.get("exitCode") == 137 or reason == "OOMKilled":
+            code = term.get("exitCode")
+            # OOM only on the kernel OOM reason or exit 247; SIGKILL/SIGTERM
+            # (137/143 — eviction, preemption) are plain kills and must not
+            # trigger the OOM memory bump on relaunch (reference:
+            # master/watcher/k8s_watcher.py _get_pod_exit_reason).
+            if reason == "OOMKilled" or code == 247:
                 exit_reason = "oom"
+            elif code in (137, 143):
+                exit_reason = "killed"
             elif reason == "Error":
                 exit_reason = "unknown_error"
     return {

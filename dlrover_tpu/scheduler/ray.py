@@ -71,7 +71,8 @@ class RayClient:
                 )
                 from dlrover_tpu.agent.master_client import MasterClient
 
-                client = MasterClient(master_addr, node_id=node_id)
+                client = MasterClient(master_addr, node_id=node_id,
+                                      node_type=node_type)
                 agent = ElasticAgent(client,
                                      WorkerSpec(entrypoint=entrypoint))
                 return agent.run()

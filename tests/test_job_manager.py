@@ -121,6 +121,66 @@ class TestPodManifest:
         }
         assert pod_to_fields(pod)["exit_reason"] == "oom"
 
+    def test_exit_code_classification(self):
+        """137/143 (SIGKILL/SIGTERM — eviction, preemption) are plain kills;
+        OOM only on reason OOMKilled or exit 247 (reference:
+        k8s_watcher.py _get_pod_exit_reason). A killed pod must not get the
+        1.5x OOM memory bump on relaunch."""
+        def pod_with(code, reason=""):
+            return {
+                "metadata": {"labels": {"dlrover-tpu/node-id": "0",
+                                        "dlrover-tpu/rank": "0",
+                                        "dlrover-tpu/type": "worker"}},
+                "status": {
+                    "phase": "Failed",
+                    "containerStatuses": [{
+                        "state": {"terminated": {"exitCode": code,
+                                                 "reason": reason}},
+                    }],
+                },
+            }
+        assert pod_to_fields(pod_with(137))["exit_reason"] == "killed"
+        assert pod_to_fields(pod_with(143))["exit_reason"] == "killed"
+        assert pod_to_fields(pod_with(247))["exit_reason"] == "oom"
+        assert pod_to_fields(
+            pod_with(137, "OOMKilled"))["exit_reason"] == "oom"
+        assert pod_to_fields(
+            pod_with(1, "Error"))["exit_reason"] == "unknown_error"
+
+    def test_patch_uses_merge_patch_content_type(self):
+        """k8s returns 415 for PATCH with a plain JSON content type."""
+        from dlrover_tpu.scheduler.kubernetes import K8sApi
+
+        captured = {}
+
+        import urllib.request
+
+        api = K8sApi.__new__(K8sApi)
+        api._host = "https://example"
+        api._token = None
+        api._ssl = None
+
+        real_urlopen = urllib.request.urlopen
+
+        def fake_urlopen(req, timeout=None, context=None):
+            captured["content_type"] = req.get_header("Content-type")
+            raise RuntimeError("stop")
+
+        urllib.request.urlopen = fake_urlopen
+        try:
+            try:
+                api.request("PATCH", "/apis/x", {"spec": {}})
+            except RuntimeError:
+                pass
+            assert captured["content_type"] == "application/merge-patch+json"
+            try:
+                api.request("POST", "/apis/x", {"spec": {}})
+            except RuntimeError:
+                pass
+            assert captured["content_type"] == "application/json"
+        finally:
+            urllib.request.urlopen = real_urlopen
+
 
 class TestJobManagerLifecycle:
     def test_initial_scale_creates_workers(self):
