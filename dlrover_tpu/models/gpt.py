@@ -15,6 +15,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from dlrover_tpu.models.llama import embed_lookup
 from dlrover_tpu.ops.flash_attention import (
     flash_attention,
     reference_attention,
@@ -31,6 +32,9 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     attn_impl: str = "flash"
+    # "onehot" matmul lookup partitions cleanly under SPMD (see
+    # LlamaConfig.embed_impl); "gather" is cheaper on a single chip.
+    embed_impl: str = "onehot"
 
     @classmethod
     def nano(cls, **kw) -> "GPTConfig":
@@ -116,7 +120,7 @@ class GPT(nn.Module):
             (cfg.block_size, cfg.n_embd), cfg.param_dtype,
         )
         seq = tokens.shape[-1]
-        x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[:seq]
+        x = embed_lookup(wte, tokens, cfg) + wpe.astype(cfg.dtype)[:seq]
         for layer in range(cfg.n_layer):
             x = Block(cfg, name=f"block_{layer}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)

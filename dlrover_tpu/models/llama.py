@@ -44,6 +44,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16        # activation/compute dtype
     param_dtype: Any = jnp.float32   # master parameter dtype
     attn_impl: str = "flash"         # "flash" | "reference"
+    # "onehot": iota/one-hot matmul lookup — SPMD-partitions as a plain
+    # matmul, so the embedding-table gradient never hits the scatter path
+    # that forces XLA into involuntary full rematerialization on a
+    # (data, fsdp, tensor) mesh. "gather" is cheaper on a single chip.
+    embed_impl: str = "onehot"
     remat: bool = False              # rematerialize each block
     # "full"/"nothing_saveable" | "dots"/"dots_saveable" | "dots_with_no_batch_dims"
     remat_policy: str = "nothing_saveable"
@@ -96,6 +101,15 @@ class LlamaConfig:
 
 def _logical(init, *axes):
     return nn.with_logical_partitioning(init, axes)
+
+
+def embed_lookup(embed: jax.Array, tokens: jax.Array, cfg: Any) -> jax.Array:
+    """Token embedding lookup; see LlamaConfig.embed_impl. cfg only needs
+    embed_impl / vocab_size / dtype (GPTConfig works too)."""
+    if cfg.embed_impl == "onehot":
+        onehot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+        return jnp.dot(onehot, embed.astype(cfg.dtype))
+    return embed.astype(cfg.dtype)[tokens]
 
 
 class RMSNorm(nn.Module):
@@ -230,7 +244,7 @@ class Llama(nn.Module):
             _logical(nn.initializers.normal(0.02), "vocab", "embed"),
             (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype,
         )
-        x = embed.astype(cfg.dtype)[tokens]
+        x = embed_lookup(embed, tokens, cfg)
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[-1]), tokens.shape)
         block_cls = DecoderBlock

@@ -23,6 +23,7 @@ from dlrover_tpu.models.llama import (
     RMSNorm,
     _logical,
     cross_entropy_loss,
+    embed_lookup,
 )
 from dlrover_tpu.ops.remat import resolve_remat_policy
 from dlrover_tpu.parallel.moe import MoEConfig, MoELayer, moe_aux_loss
@@ -110,7 +111,7 @@ class LlamaMoE(nn.Module):
             _logical(nn.initializers.normal(0.02), "vocab", "embed"),
             (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype,
         )
-        x = embed.astype(cfg.dtype)[tokens]
+        x = embed_lookup(embed, tokens, cfg)
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[-1]), tokens.shape)
         block_cls = MoEDecoderBlock

@@ -110,7 +110,10 @@ def run(args: argparse.Namespace) -> int:
         entrypoint = [sys.executable, args.entrypoint] + entrypoint
 
     node_type = os.environ.get(NodeEnv.NODE_TYPE, "worker")
-    client = MasterClient(master_addr, node_id=args.node_rank,
+    # NODE_ID diverges from rank after a relaunch (replacement nodes get a
+    # fresh id); heartbeats/failures must carry the id the master tracks
+    node_id = int(os.environ.get(NodeEnv.NODE_ID, str(args.node_rank)))
+    client = MasterClient(master_addr, node_id=node_id,
                           node_rank=args.node_rank, node_type=node_type)
     devices = args.devices_per_node or _detect_devices()
     spec = WorkerSpec(

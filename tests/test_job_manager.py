@@ -182,6 +182,47 @@ class TestPodManifest:
             urllib.request.urlopen = real_urlopen
 
 
+class TestTypedHeartbeat:
+    def _manager_with_chief(self):
+        args = make_job_args(workers=1)
+        args.node_args[NodeType.CHIEF] = NodeArgs(
+            group_resource=NodeGroupResource(
+                count=1, node_resource=NodeResource(cpu=1)),
+        )
+        cluster = LocalCluster()
+        manager = create_job_manager(args, master_addr="127.0.0.1:0",
+                                     speed_monitor=SpeedMonitor(),
+                                     cluster=cluster)
+        manager._init_nodes()
+        return manager
+
+    def test_typed_beat_only_refreshes_matching_group(self):
+        """A worker beat must not refresh the chief with the same id —
+        that misattribution masks a hung chief (ADVICE round 1)."""
+        manager = self._manager_with_chief()
+        manager.collect_heartbeat(0, 123.0, node_type=NodeType.WORKER)
+        worker = manager._nodes[NodeType.WORKER][0]
+        chief = manager._nodes[NodeType.CHIEF][0]
+        assert worker.heartbeat_time == 123.0
+        assert chief.heartbeat_time == 0.0
+
+    def test_typed_miss_falls_back_to_untyped_scan(self):
+        """An unknown node_type (old client / post-restart adoption) must
+        not silently drop the liveness signal."""
+        manager = self._manager_with_chief()
+        manager.collect_heartbeat(0, 55.0, node_type="ps")
+        assert any(
+            by_id[0].heartbeat_time == 55.0
+            for by_id in manager._nodes.values()
+        )
+
+    def test_untyped_beat_refreshes_all_groups(self):
+        manager = self._manager_with_chief()
+        manager.collect_heartbeat(0, 77.0)
+        assert manager._nodes[NodeType.WORKER][0].heartbeat_time == 77.0
+        assert manager._nodes[NodeType.CHIEF][0].heartbeat_time == 77.0
+
+
 class TestJobManagerLifecycle:
     def test_initial_scale_creates_workers(self):
         cluster, manager = start_manager(workers=3)
