@@ -32,9 +32,10 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     attn_impl: str = "flash"
-    # "onehot" matmul lookup partitions cleanly under SPMD (see
-    # LlamaConfig.embed_impl); "gather" is cheaper on a single chip.
-    embed_impl: str = "onehot"
+    # GPT is the single-host example family (nanogpt), so the cheap gather
+    # lookup is the default; set "onehot" when training on a
+    # (data, fsdp, tensor) mesh (see LlamaConfig.embed_impl for why).
+    embed_impl: str = "gather"
 
     @classmethod
     def nano(cls, **kw) -> "GPTConfig":

@@ -26,7 +26,7 @@ from dlrover_tpu.ops.flash_attention import (
     flash_attention,
     reference_attention,
 )
-from dlrover_tpu.ops.norms import reference_rms_norm
+from dlrover_tpu.ops.norms import fused_rms_norm, reference_rms_norm
 from dlrover_tpu.ops.remat import resolve_remat_policy
 
 
@@ -49,6 +49,7 @@ class LlamaConfig:
     # that forces XLA into involuntary full rematerialization on a
     # (data, fsdp, tensor) mesh. "gather" is cheaper on a single chip.
     embed_impl: str = "onehot"
+    norm_impl: str = "fused"         # "fused" (Pallas) | "reference" (XLA)
     remat: bool = False              # rematerialize each block
     # "full"/"nothing_saveable" | "dots"/"dots_saveable" | "dots_with_no_batch_dims"
     remat_policy: str = "nothing_saveable"
@@ -115,12 +116,16 @@ def embed_lookup(embed: jax.Array, tokens: jax.Array, cfg: Any) -> jax.Array:
 class RMSNorm(nn.Module):
     eps: float
     dtype: Any
+    impl: str = "fused"
 
     @nn.compact
     def __call__(self, x):
         weight = self.param(
             "weight", _logical(nn.initializers.ones, "norm"), (x.shape[-1],)
         )
+        if self.impl == "fused":
+            return fused_rms_norm(x, weight.astype(jnp.float32),
+                                  self.eps).astype(self.dtype)
         return reference_rms_norm(x, weight.astype(jnp.float32),
                                   self.eps).astype(self.dtype)
 
@@ -221,12 +226,12 @@ class DecoderBlock(nn.Module):
         # residual stream between layouts (constraint is a no-op off-mesh)
         x = nn.with_logical_constraint(x, ACT_AXES)
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.norm_impl, name="attn_norm")(x),
             positions,
         )
         x = nn.with_logical_constraint(x, ACT_AXES)
         x = x + MLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(x)
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.norm_impl, name="mlp_norm")(x)
         )
         return nn.with_logical_constraint(x, ACT_AXES)
 
@@ -255,7 +260,7 @@ class Llama(nn.Module):
             )
         for layer in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{layer}")(x, positions)
-        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.norm_impl, name="final_norm")(x)
         if cfg.tie_embeddings:
             logits = jnp.dot(x, embed.astype(cfg.dtype).T)
         else:

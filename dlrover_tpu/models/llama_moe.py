@@ -85,12 +85,12 @@ class MoEDecoderBlock(nn.Module):
     def __call__(self, x, positions):
         cfg = self.config
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.norm_impl, name="attn_norm")(x),
             positions,
         )
         x = x + MoELayer(cfg.moe_config(),
                          deterministic=self.deterministic, name="moe")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="moe_norm")(x)
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.norm_impl, name="moe_norm")(x)
         )
         return x
 
@@ -123,7 +123,7 @@ class LlamaMoE(nn.Module):
         for layer in range(cfg.num_layers):
             x = block_cls(cfg, deterministic=self.deterministic,
                           name=f"layer_{layer}")(x, positions)
-        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.norm_impl, name="final_norm")(x)
         head = self.param(
             "lm_head",
             _logical(nn.initializers.normal(0.02), "embed", "vocab"),

@@ -54,7 +54,12 @@ def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dwp_ref,
 
 
 def _rows_block(n_rows: int) -> int:
-    return min(n_rows, 256)
+    """Largest divisor of n_rows <= 256: Pallas pads out-of-bounds rows
+    with undefined data on real TPU, and the backward's dw accumulation
+    would silently fold that garbage into the weight gradient."""
+    from dlrover_tpu.ops.flash_attention import fit_block
+
+    return fit_block(n_rows, 256)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

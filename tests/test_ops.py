@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 
 from dlrover_tpu.ops.flash_attention import (
+    fit_block,
     flash_attention,
     reference_attention,
 )
@@ -36,6 +37,26 @@ class TestFlashAttentionForward:
         # seq not a multiple of block size exercises padding-free path
         q, k, v = _qkv(seq=128, dim=64)
         out = flash_attention(q, k, v, True, None, 64, 32)
+        ref = reference_attention(q, k, v, True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_fit_block_always_divides(self):
+        """Requested blocks must be rounded down to a divisor of seq —
+        on real TPU an out-of-bounds block reads undefined data and the
+        dk/dv accumulation would fold it into valid gradients."""
+        for n in [64, 128, 192, 1000, 1536, 2048, 4096, 7]:
+            for req in [128, 256, 1024]:
+                b = fit_block(n, req)
+                assert n % b == 0 and b <= max(req, 1)
+        assert fit_block(2048, 1024) == 1024
+        assert fit_block(1536, 1024) == 768   # 128-aligned divisor
+        assert fit_block(1000, 256) == 250    # no aligned divisor
+
+    def test_indivisible_seq_matches_reference(self):
+        # 192 % 128 != 0: the default 1024 request must shrink to a
+        # divisor, not pad
+        q, k, v = _qkv(seq=192, dim=64)
+        out = flash_attention(q, k, v, True)
         ref = reference_attention(q, k, v, True)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
