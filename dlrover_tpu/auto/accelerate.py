@@ -155,11 +155,6 @@ def lower(context: ModelContext) -> AccelerateResult:
                 "pipeline lowering needs a stacked-decoder model "
                 "(LlamaConfig family); for custom models call "
                 "dlrover_tpu.parallel.pipeline.pipeline_apply directly")
-        if plan.fsdp or plan.tensor_parallel:
-            logger.warning(
-                "pipeline lowering does not yet shard stage-internal "
-                "params: the requested fsdp/tensor dims apply only to the "
-                "batch; expect replicated weights within each stage")
         if plan.global_batch:
             # the accumulation geometry IS the microbatch stream: the
             # user's global batch is authoritative (accum × micro rows)
@@ -171,6 +166,7 @@ def lower(context: ModelContext) -> AccelerateResult:
             num_microbatches=num_micro, micro_batch=micro,
             seq_len=np.asarray(sample).shape[-1],
             loss_fn=context.loss_fn, remat=plan.remat,
+            rules=rules,
         )
         return AccelerateResult(trainer=trainer, mesh=mesh,
                                 model=context.model, strategy=[],

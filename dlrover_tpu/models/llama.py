@@ -123,7 +123,10 @@ class RMSNorm(nn.Module):
         weight = self.param(
             "weight", _logical(nn.initializers.ones, "norm"), (x.shape[-1],)
         )
-        if self.impl == "fused":
+        # The fused kernel only on real TPU: off-TPU it would run in
+        # Pallas interpret mode — slow, and its interpreter loop breaks
+        # the vma typing inside partial-auto shard_map (pipeline stages)
+        if self.impl == "fused" and jax.default_backend() == "tpu":
             return fused_rms_norm(x, weight.astype(jnp.float32),
                                   self.eps).astype(self.dtype)
         return reference_rms_norm(x, weight.astype(jnp.float32),

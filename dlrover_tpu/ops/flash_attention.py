@@ -40,6 +40,20 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _vma(*arrays) -> frozenset:
+    """Union of the inputs' varying-manual-axes: under a check_vma
+    shard_map (e.g. the pipeline's manual `pipe` axis) pallas_call
+    outputs must declare how they vary."""
+    u: frozenset = frozenset()
+    for a in arrays:
+        u = u | getattr(jax.typeof(a), "vma", frozenset())
+    return u
+
+
+def _sds(shape, dtype, vma):
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 def _cdiv(a: int, b: int) -> int:
     return (a + b - 1) // b
 
@@ -167,8 +181,8 @@ def _flash_fwd(q, k, v, sm_scale: float, causal: bool,
                          lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch, num_heads, seq_q, 1), jnp.float32),
+            _sds(q.shape, q.dtype, _vma(q, k, v)),
+            _sds((batch, num_heads, seq_q, 1), jnp.float32, _vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, head_dim), jnp.float32),
@@ -318,7 +332,7 @@ def _flash_bwd(res, g, *, sm_scale: float, causal: bool,
             pl.BlockSpec((1, 1, block_q, 1), row_map),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, head_dim), q_map),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_sds(q.shape, q.dtype, _vma(q, k, v, do)),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
@@ -358,10 +372,10 @@ def _flash_bwd(res, g, *, sm_scale: float, causal: bool,
             pl.BlockSpec((1, 1, block_k, head_dim), kv_out_map),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(
-                (batch, num_heads, seq_k, head_dim), q.dtype),
-            jax.ShapeDtypeStruct(
-                (batch, num_heads, seq_k, head_dim), q.dtype),
+            _sds((batch, num_heads, seq_k, head_dim), q.dtype,
+                 _vma(q, k, v, do)),
+            _sds((batch, num_heads, seq_k, head_dim), q.dtype,
+                 _vma(q, k, v, do)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, head_dim), jnp.float32),

@@ -16,6 +16,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+from dlrover_tpu.ops.flash_attention import _vma
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -92,8 +95,9 @@ def _rms_fwd(x, weight, eps):
             pl.BlockSpec((block, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, x.dtype),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct(x2.shape, x.dtype, vma=_vma(x2, weight)),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32,
+                                 vma=_vma(x2, weight)),
         ],
         interpret=_use_interpret(),
     )(x2, weight)
@@ -125,8 +129,10 @@ def _rms_bwd_vjp(eps, res, g):
             pl.BlockSpec((8, dim), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, x2.dtype),
-            jax.ShapeDtypeStruct((8, dim), jnp.float32),
+            jax.ShapeDtypeStruct(x2.shape, x2.dtype,
+                                 vma=_vma(x2, weight, g2)),
+            jax.ShapeDtypeStruct((8, dim), jnp.float32,
+                                 vma=_vma(x2, weight, g2)),
         ],
         interpret=_use_interpret(),
     )(x2, weight, rstd, g2)

@@ -6,12 +6,25 @@ split into stages, RPC driver, GPipe/interleaved schedules) and the
 DeepSpeed 3D alternative (opt_lib/ds_3d_parallel_optimization.py:53).
 
 TPU re-design: there is no RPC; all stages run the SAME jitted SPMD
-program. Stage parameters are stacked on a leading dim sharded over the
-`pipe` mesh axis; microbatches stream through a `lax.scan` whose carry is
-the activation in flight, rotated stage-to-stage with `ppermute` each
-step (GPipe schedule: num_micro + num_stages - 1 steps, bubble fraction
-(S-1)/(M+S-1)). Autodiff through scan+ppermute yields the backward
-pipeline; `jax.checkpoint` on the stage fn gives per-stage remat.
+program under a shard_map that is MANUAL only over the `pipe` axis
+(jax.shard_map `axis_names`): every other mesh axis (data/fsdp/tensor/…)
+stays "auto", so stage-internal parameters keep their fsdp/tensor
+shardings and XLA inserts the intra-stage collectives — PP composes with
+FSDP/TP the way the reference's 3D path does (ds_3d_parallel topology).
+
+Microbatch streaming is O(M/S) per stage, not O(M): the stream is stored
+round-robin across stages (microbatch m lives on stage m % S) and moves
+through two single-microbatch ring buffers — an input ring rotating toward
+stage 0 (each stage injects its next stored microbatch every S steps) and
+an output ring rotating away from the last stage (each stage deposits the
+microbatches it owns as they pass by). Per-step bandwidth is three
+microbatch-sized ppermutes (activation, input ring, output ring),
+independent of M. The GPipe schedule runs M + 2(S-1) steps: M + S - 1 for
+the pipeline itself plus up to S - 1 more for the output ring to deliver
+the last microbatch to its owner.
+
+Autodiff through scan+ppermute yields the backward pipeline;
+`jax.checkpoint` on the stage fn gives per-stage remat.
 """
 
 from __future__ import annotations
@@ -22,54 +35,88 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from dlrover_tpu.common.constants import MeshAxis
 
 
-def _pipeline_local(stage_params, inputs, *, stage_fn, axis_name: str,
-                    num_microbatches: int):
-    """Per-device body. stage_params: this stage's params (leading stage
-    dim of size 1 already squeezed by shard_map). inputs: (M, micro, ...)
-    full microbatch stream (replicated across pipe)."""
-    stage = lax.axis_index(axis_name)
-    num_stages = lax.psum(1, axis_name)
-    steps = num_microbatches + num_stages - 1  # static: mesh-sized
+def _pipeline_local(stage_params, in_store, *, stage_fn, axis_name: str,
+                    num_stages: int, stored_micro: int):
+    """Per-device body (manual over the pipe axis only).
 
-    micro_shape = inputs.shape[1:]
-    outputs0 = jnp.zeros((num_microbatches,) + micro_shape,
-                         dtype=inputs.dtype)
-    state0 = jnp.zeros(micro_shape, inputs.dtype)
+    stage_params: this stage's params (leading pipe dim of size 1 already
+    squeezed). in_store: (1, stored_micro, micro, ...) — this stage's
+    round-robin share of the stream; in_store[0, j] is microbatch
+    j * S + stage.
+    """
+    stage = lax.axis_index(axis_name)
+    in_store = in_store[0]
+    num_micro = stored_micro * num_stages
+    # Since the stream is padded to a multiple of S, the final microbatch's
+    # owner is stage S-1 (deposit at t = M+S-2) and the latest deposit
+    # overall is u = M-2 at owner S-2 (t = M+2S-4), so M + 2S - 3 steps
+    # suffice; S == 1 degenerates to plain sequential execution.
+    steps = num_micro + max(2 * num_stages - 3, 0)
+
+    micro_shape = in_store.shape[1:]
+    # carries hold per-stage values: mark them varying over the pipe axis
+    # so the vma check accepts the ppermute outputs fed back into the scan
+    zeros = lax.pcast(jnp.zeros(micro_shape, in_store.dtype), (axis_name,),
+                      to="varying")
+    out_store0 = jnp.zeros_like(in_store)  # varying: derived from in_store
+
+    fwd_perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    bwd_perm = [(i, (i - 1) % num_stages) for i in range(num_stages)]
 
     def step(carry, t):
-        state, outputs = carry
-        # stage 0 ingests microbatch t (garbage after the stream ends —
-        # masked out at collection time)
-        inp = inputs[jnp.minimum(t, num_microbatches - 1)]
-        state = jnp.where(stage == 0, inp, state)
-        state = stage_fn(stage_params, state)
-        # last stage emits microbatch t - (S-1) once warmed up
-        out_idx = t - (num_stages - 1)
-        valid = jnp.logical_and(stage == num_stages - 1, out_idx >= 0)
-        outputs = lax.dynamic_update_index_in_dim(
-            outputs,
-            jnp.where(valid, state,
-                      lax.dynamic_index_in_dim(
-                          outputs, jnp.maximum(out_idx, 0), 0,
-                          keepdims=False)),
-            jnp.maximum(out_idx, 0), 0)
-        state = lax.ppermute(
-            state, axis_name,
-            [(i, (i + 1) % num_stages) for i in range(num_stages)])
-        return (state, outputs), None
+        act, in_slot, out_slot, out_store = carry
 
-    (_, outputs), _ = lax.scan(step, (state0, outputs0),
-                               jnp.arange(steps))
-    # outputs are only populated on the last stage; psum broadcasts them
-    # (every other stage holds zeros)
-    mask = (stage == num_stages - 1).astype(outputs.dtype)
-    return lax.psum(outputs * mask, axis_name)
+        # -- input ring: every S steps each stage loads its next stored
+        # microbatch into the slot currently at its position; the slot
+        # reaches stage 0 exactly when that microbatch is due.
+        load_idx = jnp.minimum(t // num_stages, stored_micro - 1)
+        in_slot = jnp.where(t % num_stages == 0,
+                            in_store[load_idx], in_slot)
+
+        # -- stage 0 ingests microbatch t (garbage after the stream ends;
+        # those outputs are never deposited)
+        x = jnp.where(stage == 0, in_slot, act)
+        y = stage_fn(stage_params, x)
+
+        # -- output ring: the last stage writes its fresh output into the
+        # slot at its position, then whichever stage owns the slot's
+        # content deposits it. Content u at stage s (after the write):
+        #   s == S-1: u = t - (S-1)
+        #   else:     u = t - (S-1) - (s+1)
+        produced = t - (num_stages - 1)
+        out_slot = jnp.where(
+            jnp.logical_and(stage == num_stages - 1,
+                            jnp.logical_and(produced >= 0,
+                                            produced < num_micro)),
+            y, out_slot)
+        u = jnp.where(stage == num_stages - 1,
+                      t - (num_stages - 1),
+                      t - num_stages - stage)
+        deposit = jnp.logical_and(
+            jnp.logical_and(u >= 0, u < num_micro),
+            u % num_stages == stage)
+        dep_idx = jnp.clip(u // num_stages, 0, stored_micro - 1)
+        current = lax.dynamic_index_in_dim(out_store, dep_idx, 0,
+                                           keepdims=False)
+        out_store = lax.dynamic_update_index_in_dim(
+            out_store, jnp.where(deposit, out_slot, current), dep_idx, 0)
+
+        # -- rotate: activations toward higher stages, input ring toward
+        # stage 0, output ring away from the last stage
+        act = lax.ppermute(y, axis_name, fwd_perm)
+        in_slot = lax.ppermute(in_slot, axis_name, bwd_perm)
+        out_slot = lax.ppermute(out_slot, axis_name, fwd_perm)
+        return (act, in_slot, out_slot, out_store), None
+
+    (_, _, _, out_store), _ = lax.scan(
+        step, (zeros, zeros, zeros, out_store0), jnp.arange(steps))
+    return out_store[None]
 
 
 def pipeline_apply(
@@ -79,40 +126,49 @@ def pipeline_apply(
     inputs: jax.Array,
     axis: str = MeshAxis.PIPE,
     remat: bool = False,
-    batch_axes=None,
 ) -> jax.Array:
     """Run `inputs` (num_microbatches, micro, ...) through the pipeline.
 
     stacked_params: pytree whose leaves have a leading stage dim of size
     mesh.shape[axis]; stage_fn(params_one_stage, x) -> y with y.shape ==
-    x.shape (uniform-stage contract, same as GPipe splits).
-
-    batch_axes: mesh axes the micro (row) dim is sharded over — PP×DP
-    composition: each data replica pipelines only its row shard. None =
-    replicated rows (pure PP).
+    x.shape (uniform-stage contract, same as GPipe splits). Leaves may be
+    sharded over other mesh axes (fsdp/tensor) on their trailing dims —
+    those axes are auto inside the pipe shard_map, so XLA keeps the
+    sharding and inserts the intra-stage collectives. The micro (row) dim
+    sharding likewise flows through the auto axes — each data replica
+    pipelines its own row shard.
     """
     num_stages = mesh.shape[axis]
-    num_microbatches = inputs.shape[0]
-    fn = stage_fn
-    if remat:
-        fn = jax.checkpoint(stage_fn)
+    num_micro = inputs.shape[0]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    # round-robin storage layout: padded[j * S + s] -> stage s, slot j
+    pad = (-num_micro) % num_stages
+    if pad:
+        inputs = jnp.concatenate(
+            [inputs, jnp.zeros((pad,) + inputs.shape[1:], inputs.dtype)])
+    stored = inputs.shape[0] // num_stages
+    staged = inputs.reshape((stored, num_stages) + inputs.shape[1:])
+    staged = jnp.swapaxes(staged, 0, 1)  # (S, stored, micro, ...)
 
     def body(params, x):
         squeezed = jax.tree.map(lambda p: p[0], params)
         return _pipeline_local(
             squeezed, x, stage_fn=fn, axis_name=axis,
-            num_microbatches=num_microbatches)
+            num_stages=num_stages, stored_micro=stored)
 
     params_spec = jax.tree.map(lambda _: P(axis), stacked_params)
-    data_spec = P(None, batch_axes) if batch_axes is not None else P()
     piped = shard_map(
         body,
         mesh=mesh,
-        in_specs=(params_spec, data_spec),
-        out_specs=data_spec,
-        check_vma=False,
+        in_specs=(params_spec, P(axis)),
+        out_specs=P(axis),
+        axis_names=frozenset({axis}),
     )
-    return piped(stacked_params, inputs)
+    out = piped(stacked_params, staged)   # (S, stored, micro, ...)
+    out = jnp.swapaxes(out, 0, 1).reshape(
+        (stored * num_stages,) + out.shape[2:])
+    return out[:num_micro]
 
 
 def stack_stage_params(per_stage_params) -> Any:

@@ -1,20 +1,23 @@
 """Pipelined transformer trainer: GPipe over stacked decoder layers.
 
 Capability parity: atorch's pipeline-parallel training path (PiPPy
-compile → stages → driver, distributed_pippy_compiler.py:378; DeepSpeed
-3D alternative). TPU re-design (scan-over-layers lineage): decoder-layer
-params are stacked (num_stages, layers_per_stage, ...) with the stage dim
-sharded over the `pipe` mesh axis; the forward runs embedding (replicated
-compute), then `pipeline_apply` streams microbatch row-shards through the
-stages with ppermute (each data replica pipelines its own rows — PP×DP),
-then the LM head. Same init/step/shard_batch surface as build_trainer.
-
-Current scope: stage-internal params are not additionally TP/FSDP-sharded
-(lowering warns when those were requested together with pipe); the
-embedding/head are replicated.
+compile → stages → driver, distributed_pippy_compiler.py:378) and the
+DeepSpeed 3D composition (ds_3d_parallel_optimization.py:53 — pipe ×
+tensor × data in one topology). TPU re-design (scan-over-layers lineage):
+decoder-layer params are stacked (num_stages, layers_per_stage, ...) with
+the stage dim sharded over the `pipe` mesh axis AND their trailing dims
+sharded over fsdp/tensor through the model's logical axis names — the
+pipe shard_map is manual only over `pipe` (jax.shard_map axis_names), so
+XLA keeps the stage-internal shardings and inserts the intra-stage
+collectives. The forward runs the embedding, streams microbatch row
+shards through the stages (each data replica pipelines its own rows —
+PP × DP × FSDP/TP), then the LM head. Same init/step/shard_batch surface
+as build_trainer.
 """
 
 from __future__ import annotations
+
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax
@@ -24,8 +27,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.common.constants import MeshAxis
-from dlrover_tpu.models.llama import DecoderBlock, LlamaConfig
+from dlrover_tpu.models.llama import DecoderBlock, LlamaConfig, embed_lookup
 from dlrover_tpu.parallel.pipeline import pipeline_apply
+from dlrover_tpu.parallel.sharding import DEFAULT_RULES
 from dlrover_tpu.trainer.train_step import TrainState
 
 _BATCH_AXES = (MeshAxis.DATA, MeshAxis.FSDP)
@@ -83,7 +87,8 @@ class PipelinedLlamaTrainer:
 
     def __init__(self, cfg: LlamaConfig, tx: optax.GradientTransformation,
                  mesh: Mesh, num_microbatches: int, micro_batch: int,
-                 seq_len: int, loss_fn, remat: bool = False):
+                 seq_len: int, loss_fn, remat: bool = False,
+                 rules: Optional[Sequence] = None):
         self.cfg = cfg
         self.mesh = mesh
         self.num_stages = mesh.shape[MeshAxis.PIPE]
@@ -94,17 +99,46 @@ class PipelinedLlamaTrainer:
         self._tx = tx
         self._loss_fn = loss_fn
         self._remat = remat
+        self._rules = list(rules if rules is not None else DEFAULT_RULES)
         # batch arrays: (M, micro, seq) with micro rows over the dp axes
         self.batch_sharding = NamedSharding(mesh, P(None, _BATCH_AXES))
         self.state_shardings = None
         self._step = None
 
     # -- params ---------------------------------------------------------
-    def _sharding_for_path(self, path) -> NamedSharding:
-        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
-        if "stages" in keys:
-            return NamedSharding(self.mesh, P(MeshAxis.PIPE))
-        return NamedSharding(self.mesh, P())
+    def _param_shardings(self):
+        """NamedSharding tree matching the params dict: stage leaves get
+        P(pipe, None, *mesh-mapped logical axes) — stage-internal
+        fsdp/tensor sharding composed with pipe (the reference's 3D
+        topology, ds_3d_parallel_optimization.py:53)."""
+        cfg = self.cfg
+        block = DecoderBlock(cfg)
+        x = jnp.zeros((1, self.seq_len, cfg.hidden_size), cfg.dtype)
+        positions = jnp.zeros((1, self.seq_len), jnp.int32)
+        from dlrover_tpu.parallel.sharding import mesh_shardings
+
+        boxed = jax.eval_shape(
+            lambda r: block.init(r, x, positions)["params"],
+            jax.random.PRNGKey(0))
+        block_shardings = mesh_shardings(boxed, self.mesh, self._rules)
+        stage_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh,
+                                    P(MeshAxis.PIPE, None, *s.spec)),
+            block_shardings,
+            is_leaf=lambda s: isinstance(s, NamedSharding),
+        )
+
+        def from_logical(*names):
+            sh = nn.logical_to_mesh_sharding(
+                P(*names), self.mesh, self._rules)
+            return NamedSharding(self.mesh, sh.spec)
+
+        return {
+            "embed": from_logical("vocab", "embed"),
+            "stages": stage_shardings,
+            "final_norm": from_logical("norm"),
+            "lm_head": from_logical("embed", "vocab"),
+        }
 
     def init(self, rng: jax.Array) -> TrainState:
         def make_state(rng):
@@ -115,10 +149,28 @@ class PipelinedLlamaTrainer:
                               opt_state=self._tx.init(params))
 
         abstract = jax.eval_shape(make_state, rng)
-        # stage tensors (and their optimizer moments, which mirror the
-        # param tree) shard over pipe; everything else replicated
+        param_shardings = self._param_shardings()
+        flat_params = {
+            tuple(str(getattr(k, "key", k)) for k in path): sharding
+            for path, sharding in
+            jax.tree_util.tree_flatten_with_path(param_shardings)[0]
+        }
+        replicated = NamedSharding(self.mesh, P())
+
+        def for_path(path, leaf):
+            """Optimizer moments mirror the params tree: match the longest
+            path suffix against the params sharding table."""
+            keys = tuple(str(getattr(k, "key", getattr(k, "name", k)))
+                         for k in path)
+            for start in range(len(keys)):
+                if keys[start:] in flat_params:
+                    sharding = flat_params[keys[start:]]
+                    if len(sharding.spec) <= leaf.ndim:
+                        return sharding
+            return replicated
+
         self.state_shardings = jax.tree_util.tree_map_with_path(
-            lambda path, _: self._sharding_for_path(path), abstract)
+            for_path, abstract)
         # jit with out_shardings: nothing ever materializes replicated
         return jax.jit(make_state,
                        out_shardings=self.state_shardings)(rng)
@@ -134,10 +186,10 @@ class PipelinedLlamaTrainer:
     # -- step -----------------------------------------------------------
     def _forward(self, params, tokens):
         cfg = self.cfg
-        x = params["embed"].astype(cfg.dtype)[tokens]  # (M, mb, S, H)
+        x = embed_lookup(params["embed"], tokens, cfg)  # (M, mb, S, H)
         out = pipeline_apply(
             self.mesh, _stage_fn_factory(cfg), params["stages"],
-            x, remat=self._remat, batch_axes=_BATCH_AXES)
+            x, remat=self._remat)
         from dlrover_tpu.ops.norms import reference_rms_norm
 
         out = reference_rms_norm(out, params["final_norm"]
@@ -173,7 +225,9 @@ def build_pipeline_trainer(cfg: LlamaConfig,
                            tx: optax.GradientTransformation,
                            mesh: Mesh, num_microbatches: int,
                            micro_batch: int, seq_len: int, loss_fn,
-                           remat: bool = False) -> PipelinedLlamaTrainer:
+                           remat: bool = False,
+                           rules: Optional[Sequence] = None
+                           ) -> PipelinedLlamaTrainer:
     return PipelinedLlamaTrainer(cfg, tx, mesh, num_microbatches,
                                  micro_batch, seq_len, loss_fn,
-                                 remat=remat)
+                                 remat=remat, rules=rules)
