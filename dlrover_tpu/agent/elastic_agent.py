@@ -89,7 +89,8 @@ class ElasticAgent:
         (reference: MasterRendezvousHandler.next_rendezvous training.py:180).
         """
         spec = self._spec
-        self._client.join_rendezvous(spec.devices_per_node, self._rdzv_name)
+        joined_round = self._client.join_rendezvous(
+            spec.devices_per_node, self._rdzv_name)
         deadline = time.time() + spec.rdzv_timeout_s
         while time.time() < deadline:
             rdzv_round, _, world = self._client.get_comm_world(
@@ -98,6 +99,16 @@ class ElasticAgent:
             if world and self._client.node_rank in world:
                 self.last_world, self.last_round = world, rdzv_round
                 return rdzv_round, world
+            if rdzv_round > joined_round:
+                # Our round was cut without us — the world was invalidated
+                # by a member death, or node_unit rounding dropped us.
+                # Re-join so the next round can include this node.
+                logger.info(
+                    "rendezvous round %d passed without this node; "
+                    "re-joining", joined_round,
+                )
+                joined_round = self._client.join_rendezvous(
+                    spec.devices_per_node, self._rdzv_name)
             time.sleep(0.5)
         raise RendezvousTimeoutError(
             f"rendezvous {self._rdzv_name!r} did not complete within "
@@ -257,9 +268,24 @@ class ElasticAgent:
         self._stop_worker()
 
 
+def apply_jax_platform_env() -> None:
+    """Honor ``JAX_PLATFORMS`` explicitly in worker processes.
+
+    Platform plugins registered from site hooks can prepend themselves to
+    ``jax_platforms`` regardless of the env var, so a worker the agent
+    intended to run on a specific platform (e.g. tests forcing ``cpu``)
+    must re-assert it through jax.config before backend init."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+
+
 def init_distributed() -> None:
     """Training-process entry: initialize jax.distributed from the agent's
     env contract. No-op single-process (standalone runs)."""
+    apply_jax_platform_env()
     world_size = int(os.getenv(NodeEnv.WORLD_SIZE, "1"))
     if world_size <= 1:
         return

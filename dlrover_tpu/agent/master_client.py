@@ -75,6 +75,17 @@ class MasterClient:
                                  timeout=self._timeout_s)
         return msg.deserialize_message(data)
 
+    def _report_typed(self, request: msg.Message,
+                      expected: type) -> msg.Message:
+        """`report` that enforces the response type (see `_get_typed`)."""
+        response = self._report(request)
+        if not isinstance(response, expected):
+            reason = getattr(response, "reason", repr(response))
+            raise RuntimeError(
+                f"master error for {type(request).__name__}: {reason}"
+            )
+        return response
+
     def close(self) -> None:
         self._channel.close()
 
@@ -119,14 +130,16 @@ class MasterClient:
     # -- rendezvous -------------------------------------------------------
     @retry_rpc()
     def join_rendezvous(self, local_world_size: int,
-                        rdzv_name: str = RendezvousName.TRAINING) -> bool:
-        return self._report(msg.JoinRendezvousRequest(
+                        rdzv_name: str = RendezvousName.TRAINING) -> int:
+        """Returns the rendezvous round this node was placed in."""
+        result = self._report_typed(msg.JoinRendezvousRequest(
             node_id=self.node_id,
             node_rank=self.node_rank,
             local_world_size=local_world_size,
             rdzv_name=rdzv_name,
             node_ip=local_ip(),
-        )).success
+        ), msg.JoinRendezvousResult)
+        return result.round
 
     @retry_rpc(retries=3)
     def get_comm_world(self, rdzv_name: str = RendezvousName.TRAINING
@@ -168,13 +181,9 @@ class MasterClient:
                                msg.KeyValuePair).value
 
     def kv_add(self, key: str, amount: int) -> int:
-        result = self._report(msg.KVAddRequest(key=key, amount=amount))
-        if not isinstance(result, msg.KVIntResult):
-            raise RuntimeError(
-                f"master error for KVAddRequest: "
-                f"{getattr(result, 'reason', repr(result))}"
-            )
-        return result.value
+        return self._report_typed(
+            msg.KVAddRequest(key=key, amount=amount), msg.KVIntResult,
+        ).value
 
     def kv_wait(self, key: str, timeout_s: float = 300.0) -> bytes:
         """Block until the key appears: the master holds each RPC open on a

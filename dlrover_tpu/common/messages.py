@@ -177,6 +177,14 @@ class JoinRendezvousRequest(Message):
 
 
 @dataclass
+class JoinRendezvousResult(Message):
+    # The round this joiner will be placed in; the agent re-joins if it sees
+    # get_comm_world advance past this round without including it (world
+    # invalidated by a member death, or dropped by node_unit rounding).
+    round: int = 0
+
+
+@dataclass
 class WaitingNodeNumRequest(Message):
     node_id: int = -1
     rdzv_name: str = ""

@@ -54,14 +54,16 @@ class RendezvousMembershipCallback(NodeEventCallback):
         for mgr in self._rdzv_managers.values():
             mgr.add_alive_node(node.rank_index)
 
-    def _drop(self, node: Node) -> None:
+    def _drop(self, node: Node, graceful: bool = False) -> None:
         for mgr in self._rdzv_managers.values():
-            mgr.remove_alive_node(node.rank_index)
+            mgr.remove_alive_node(node.rank_index, graceful=graceful)
         self._speed_monitor.remove_running_worker(node.id)
         self._speed_monitor.reset_running_speed()
 
     def on_node_succeeded(self, node: Node) -> None:
-        self._drop(node)
+        # A clean exit must not invalidate the cut world — survivors are
+        # finishing their own work and must not be forced to restart.
+        self._drop(node, graceful=True)
 
     def on_node_failed(self, node: Node) -> None:
         logger.info("rendezvous membership: dropping failed %s", node.name)

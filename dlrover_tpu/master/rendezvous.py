@@ -57,6 +57,10 @@ class RendezvousManager:
         self._latest_world: Dict[int, int] = {}   # node_rank -> local_world
         self._latest_round_start = 0.0
         self._node_ips: Dict[int, str] = {}
+        # True between "a member of the latest world died" and "a fresh
+        # round was cut": the stale world must never be handed out, and
+        # healthy survivors must be told to restart (membership change).
+        self._world_invalidated = False
 
     # -- membership (driven by the node manager / event callbacks) --------
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
@@ -71,10 +75,31 @@ class RendezvousManager:
         with self._lock:
             self._alive_nodes.add(node_rank)
 
-    def remove_alive_node(self, node_rank: int) -> None:
+    def remove_alive_node(self, node_rank: int,
+                          graceful: bool = False) -> None:
+        """Drop a node from membership. ``graceful`` marks a clean exit
+        (worker finished): survivors keep running, so the cut world stays
+        valid for them and must NOT be invalidated — only a death does."""
         with self._lock:
             self._alive_nodes.discard(node_rank)
             self._waiting.pop(node_rank, None)
+            if not graceful and node_rank in self._latest_world:
+                # A member of the cut round died: any survivor handed this
+                # world would only find out at jax.distributed.initialize
+                # timeout. Empty it so polls report "still forming" and
+                # survivors re-join for a fresh round.
+                logger.info(
+                    "%s rendezvous: node %d died after round %d was cut; "
+                    "invalidating the world", self.name, node_rank,
+                    self._rdzv_round - 1,
+                )
+                self._latest_world = {}
+                self._world_invalidated = True
+                self._on_world_invalidated()
+
+    def _on_world_invalidated(self) -> None:
+        """Hook for subclasses holding state keyed on the cut world
+        (lock held)."""
 
     # -- agent-facing protocol --------------------------------------------
     def join_rendezvous(self, node_rank: int, local_world_size: int,
@@ -109,6 +134,10 @@ class RendezvousManager:
         """Agents restart workers when >0 while healthy (membership change;
         reference: training.py:483-486)."""
         with self._lock:
+            if self._world_invalidated:
+                # A world member died: healthy survivors must restart and
+                # re-join even before anyone reaches the waiting list.
+                return max(1, len(self._waiting))
             # Before the first round there is no world to change.
             if not self._latest_world:
                 return 0
@@ -152,6 +181,7 @@ class RendezvousManager:
         for rank in chosen:
             del self._waiting[rank]
         self._rdzv_round += 1
+        self._world_invalidated = False
         logger.info(
             "%s rendezvous round %d completed: world=%s",
             self.name, self._rdzv_round - 1, sorted(self._latest_world),
@@ -202,10 +232,16 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             round_idx = self._rdzv_round - 1
             groups = self._groups.get(round_idx, [])
             for gi, group in enumerate(groups):
-                if node_rank in group:
+                if (node_rank in group
+                        and all(r in self._latest_world for r in group)):
                     world = {r: self._latest_world[r] for r in group}
                     return round_idx, gi, world
             return self._rdzv_round, 0, {}
+
+    def _on_world_invalidated(self) -> None:
+        # Groups are keyed on the cut world; a member death makes the
+        # latest round's grouping stale (lock held).
+        self._groups.pop(self._rdzv_round - 1, None)
 
     def _group_nodes(self, check_round: int) -> List[List[int]]:
         """Pair nodes for the probe (lock held). Round 0: adjacent pairs.

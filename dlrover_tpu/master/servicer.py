@@ -156,8 +156,9 @@ class MasterServicer:
             )
         elif isinstance(request, msg.JoinRendezvousRequest):
             mgr = self.rdzv_managers[request.rdzv_name]
-            mgr.join_rendezvous(request.node_rank, request.local_world_size,
-                                request.node_ip)
+            rdzv_round = mgr.join_rendezvous(
+                request.node_rank, request.local_world_size, request.node_ip)
+            return msg.JoinRendezvousResult(round=rdzv_round)
         elif isinstance(request, msg.NetworkStatusReport):
             mgr = self.rdzv_managers[RendezvousName.NETWORK_CHECK]
             mgr.report_network_status(request.node_id, request.normal,

@@ -12,7 +12,9 @@ _FLAG = "--xla_force_host_platform_device_count=8"
 _existing = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _existing:
     os.environ["XLA_FLAGS"] = (_existing + " " + _FLAG).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force — not setdefault: the shell profile may export an accelerator
+# platform; tests (and every subprocess they spawn) must be CPU-deterministic.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 # jax may already be imported by a pytest plugin; XLA_FLAGS is only read at
 # backend init, which must not have happened yet.

@@ -158,6 +158,19 @@ class TestNetworkCheckRendezvous:
             mgr.report_network_status(rank, True, t)
         assert mgr.detect_stragglers() == [3]
 
+    def test_member_death_drops_stale_groups(self):
+        """A post-cut member death must not leave the check groups keyed on
+        the emptied world (survivor polls raised KeyError)."""
+        mgr = NetworkCheckRendezvousManager(
+            RendezvousParameters(1, 2, 0.0)
+        )
+        self._join_all(mgr, 2)
+        _, _, world = mgr.get_comm_world(0)
+        assert set(world) == {0, 1}
+        mgr.remove_alive_node(1)
+        rnd, _, world = mgr.get_comm_world(0)   # must not raise
+        assert world == {}
+
     def test_odd_node_count_merges_singleton(self):
         mgr = NetworkCheckRendezvousManager(
             RendezvousParameters(3, 3, 0.0)
@@ -177,6 +190,46 @@ class TestRendezvousOverflow:
         _, _, world = mgr.get_comm_world(0)
         assert len(world) == 2
         assert mgr.num_nodes_waiting() == 1
+
+    def test_member_death_invalidates_cut_world(self):
+        """A member dying AFTER the round was cut must invalidate the world:
+        a survivor that never re-joined would otherwise be handed a world
+        containing the dead peer and only find out at
+        jax.distributed.initialize timeout."""
+        mgr = make_mgr(1, 3, wait=0.0)
+        mgr.join_rendezvous(0, 4)
+        mgr.join_rendezvous(1, 4)
+        rnd0, _, world0 = mgr.get_comm_world(0)
+        assert set(world0) == {0, 1}
+        mgr.remove_alive_node(1)         # node 1 dies after the cut
+        # Survivor 0 (which has NOT re-joined) must not see the stale world.
+        rnd, _, world = mgr.get_comm_world(0)
+        assert world == {}
+        # Healthy survivors are told to restart (membership change signal)
+        # even before anyone reaches the waiting list.
+        assert mgr.num_nodes_waiting() > 0
+        # The poll reported a round beyond the one node 0 joined — the agent
+        # re-joins and a fresh round cuts with the survivor only.
+        assert rnd > rnd0
+        mgr.join_rendezvous(0, 4)
+        rnd1, _, world1 = mgr.get_comm_world(0)
+        assert world1 == {0: 4} and rnd1 == rnd0 + 1
+        # Signal clears once the fresh round is cut.
+        assert mgr.num_nodes_waiting() == 0
+
+    def test_graceful_exit_keeps_world_valid(self):
+        """A node finishing cleanly must NOT invalidate the world: the
+        survivors are finishing their own work and must not be told to
+        restart into a rendezvous that can never complete."""
+        mgr = make_mgr(2, 2, wait=3600.0)
+        mgr.join_rendezvous(0, 4)
+        mgr.join_rendezvous(1, 4)
+        _, _, world = mgr.get_comm_world(0)
+        assert set(world) == {0, 1}
+        mgr.remove_alive_node(1, graceful=True)   # node 1 finished
+        _, _, world = mgr.get_comm_world(0)
+        assert set(world) == {0, 1}               # world still valid
+        assert mgr.num_nodes_waiting() == 0       # no restart signal
 
     def test_rejoined_node_sees_forming_not_stale_world(self):
         """A node that re-joined for the next round must not receive the
