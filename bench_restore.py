@@ -1,0 +1,224 @@
+"""Elastic-restore benchmark: SIGKILL -> first step after restore, in seconds.
+
+The north-star metric (BASELINE.md): elastic-restore wall-clock < 30 s after
+a single-host kill. This bench runs the REAL stack — a standalone JobMaster,
+an ElasticAgent, and a training worker subprocess using ElasticTrainLoop with
+flash (async Orbax) checkpointing — then SIGKILLs the worker mid-training and
+clocks kill -> failure detection -> re-rendezvous -> respawn -> restore ->
+first completed step.
+
+Prints ONE JSON line:
+    {"metric": "elastic_restore_seconds", "value": S, "unit": "...",
+     "vs_baseline": 30.0 / S}
+
+Run directly (`python bench_restore.py`) or via bench.py, which folds the
+number into the headline metric. Worker mode (`--worker`) is internal.
+
+Reference behavior being measured: the agent restart path
+(dlrover/python/elastic_agent/torch/training.py:429-521) combined with the
+checkpoint-restore the reference left as a TODO
+(dlrover/trainer/torch/elastic/trainer.py:295-319).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+# Keep this module import-light: the orchestrator must NOT touch the
+# accelerator (the worker subprocess owns it).
+
+KILL_AFTER_STEP = 4        # ensure a committed checkpoint exists (interval 2)
+SAVE_INTERVAL = 2
+GLOBAL_BATCH = 8
+SEQ_LEN = 128
+
+
+def _emit(events_file: str, event: dict) -> None:
+    event = dict(event, t=time.time())
+    with open(events_file, "a") as f:
+        f.write(json.dumps(event) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _read_events(events_file: str) -> list:
+    try:
+        with open(events_file) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except FileNotFoundError:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# Worker (runs under the ElasticAgent)
+# ---------------------------------------------------------------------------
+
+
+def worker_main(ckpt_dir: str, events_file: str, total_steps: int) -> int:
+    from dlrover_tpu.agent.elastic_agent import init_distributed
+
+    init_distributed()   # applies JAX_PLATFORMS + joins the process set
+
+    import jax
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        cross_entropy_loss,
+    )
+    from dlrover_tpu.trainer.elastic_loop import (
+        ElasticTrainLoop,
+        TrainLoopConfig,
+    )
+
+    cfg = LlamaConfig.tiny(attn_impl="reference", norm_impl="reference")
+    loop = ElasticTrainLoop(
+        Llama(cfg),
+        optax.adamw(3e-4),
+        cross_entropy_loss,
+        TrainLoopConfig(
+            global_batch=GLOBAL_BATCH,
+            seq_len=SEQ_LEN,
+            checkpoint_dir=ckpt_dir,
+            save_interval_steps=SAVE_INTERVAL,
+            report_interval_steps=10**9,
+        ),
+    )
+    loop.install_signal_handler()
+    state, start = loop.restore_or_init(jax.random.PRNGKey(0))
+    _emit(events_file, {"event": "restored", "step": start})
+
+    rng = np.random.default_rng(start)
+    step = start
+    while step < total_steps:
+        tokens = rng.integers(0, cfg.vocab_size, (GLOBAL_BATCH, SEQ_LEN),
+                              dtype=np.int32)
+        targets = rng.integers(0, cfg.vocab_size, (GLOBAL_BATCH, SEQ_LEN),
+                               dtype=np.int32)
+        state, _ = loop.run(state, [(tokens, targets)], start_step=step)
+        step += 1
+        _emit(events_file, {"event": "step", "step": step,
+                            "restored_from": start})
+        if loop._stop_requested.is_set():
+            break
+    loop.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator
+# ---------------------------------------------------------------------------
+
+
+def run_bench(timeout_s: float = 480.0) -> dict:
+    from dlrover_tpu.agent.elastic_agent import ElasticAgent, WorkerSpec
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.job_master import JobMaster
+
+    workdir = tempfile.mkdtemp(prefix="bench-restore-")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    events_file = os.path.join(workdir, "events.jsonl")
+
+    master = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    master.prepare()
+    client = MasterClient(master.addr, node_id=0, node_rank=0)
+    spec = WorkerSpec(
+        entrypoint=[
+            sys.executable, os.path.abspath(__file__), "--worker",
+            "--ckpt-dir", ckpt_dir, "--events-file", events_file,
+        ],
+        devices_per_node=1,
+        max_restarts=3,
+        monitor_interval_s=0.2,
+        enable_monitors=False,
+        env={"JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0"},
+    )
+    agent = ElasticAgent(client, spec)
+    agent_result: dict = {}
+    agent_thread = threading.Thread(
+        target=lambda: agent_result.update(code=agent.run()), daemon=True)
+    agent_thread.start()
+
+    deadline = time.time() + timeout_s
+
+    def _wait_for(predicate, what: str):
+        while time.time() < deadline:
+            events = _read_events(events_file)
+            hit = predicate(events)
+            if hit is not None:
+                return hit
+            time.sleep(0.05)
+        raise TimeoutError(f"timed out waiting for {what}")
+
+    try:
+        # Phase 1: train past a committed checkpoint.
+        _wait_for(
+            lambda evs: next(
+                (e for e in evs
+                 if e["event"] == "step" and e["step"] >= KILL_AFTER_STEP),
+                None),
+            f"step {KILL_AFTER_STEP}",
+        )
+        victim_pid = agent._proc.pid
+        os.kill(victim_pid, signal.SIGKILL)
+        t_kill = time.time()
+
+        # Phase 2: agent detects the death, re-rendezvouses, respawns; the
+        # new worker restores and completes its first step.
+        first = _wait_for(
+            lambda evs: next(
+                (e for e in evs
+                 if e["event"] == "step" and e.get("restored_from", 0) > 0
+                 and e["t"] > t_kill),
+                None),
+            "first step after restore",
+        )
+        restored = next(
+            e for e in _read_events(events_file)
+            if e["event"] == "restored" and e["t"] > t_kill)
+        elapsed = first["t"] - t_kill
+        return {
+            "elastic_restore_seconds": round(elapsed, 2),
+            "restored_step": restored["step"],
+            "first_step_after_restore": first["step"],
+        }
+    finally:
+        agent.shutdown()
+        client.close()
+        master.stop()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("bench_restore")
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--ckpt-dir", default="")
+    parser.add_argument("--events-file", default="")
+    parser.add_argument("--total-steps", type=int, default=10**6)
+    parser.add_argument("--timeout", type=float, default=480.0)
+    args = parser.parse_args()
+    if args.worker:
+        return worker_main(args.ckpt_dir, args.events_file, args.total_steps)
+    result = run_bench(timeout_s=args.timeout)
+    seconds = result["elastic_restore_seconds"]
+    print(json.dumps({
+        "metric": "elastic_restore_seconds",
+        "value": seconds,
+        "unit": ("s (SIGKILL -> detect -> re-rendezvous -> respawn -> "
+                 f"restore step {result['restored_step']} -> first step; "
+                 "1 host)"),
+        "vs_baseline": round(30.0 / max(seconds, 1e-9), 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -82,6 +82,10 @@ class ElasticAgent:
         self.metrics_file = os.path.join(self._workdir, "metrics.jsonl")
         self.chip_stats_file = os.path.join(self._workdir, "chips.json")
         self.paral_config_file = os.path.join(self._workdir, "paral.json")
+        # Persistent XLA compile cache shared across worker restarts: an
+        # elastic restart re-lowers the same programs, so the respawned
+        # worker skips compilation — the dominant cost of a fast restore.
+        self.compile_cache_dir = os.path.join(self._workdir, "xla-cache")
 
     # -- rendezvous --------------------------------------------------------
     def rendezvous(self) -> Tuple[int, Dict[int, int]]:
@@ -140,6 +144,7 @@ class ElasticAgent:
             NodeEnv.CHIP_STATS_FILE: self.chip_stats_file,
             NodeEnv.PARAL_CONFIG_PATH: self.paral_config_file,
         })
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", self.compile_cache_dir)
         return env
 
     # -- worker lifecycle --------------------------------------------------

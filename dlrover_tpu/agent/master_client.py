@@ -58,17 +58,21 @@ class MasterClient:
                               timeout=self._timeout_s)
         return msg.deserialize_message(data)
 
-    def _get_typed(self, request: msg.Message, expected: type) -> msg.Message:
-        """`get` that enforces the response type — a generic failure Response
-        becomes a raisable (and retryable) error instead of an
-        AttributeError in the caller."""
-        response = self._get(request)
+    def _typed(self, send, request: msg.Message,
+               expected: type) -> msg.Message:
+        """Send via ``send`` and enforce the response type — a generic
+        failure Response becomes a raisable (and retryable) error instead
+        of an AttributeError in the caller."""
+        response = send(request)
         if not isinstance(response, expected):
             reason = getattr(response, "reason", repr(response))
             raise RuntimeError(
                 f"master error for {type(request).__name__}: {reason}"
             )
         return response
+
+    def _get_typed(self, request: msg.Message, expected: type) -> msg.Message:
+        return self._typed(self._get, request, expected)
 
     def _report(self, request: msg.Message) -> msg.Message:
         data = self._stub.report(msg.serialize_message(request),
@@ -77,14 +81,7 @@ class MasterClient:
 
     def _report_typed(self, request: msg.Message,
                       expected: type) -> msg.Message:
-        """`report` that enforces the response type (see `_get_typed`)."""
-        response = self._report(request)
-        if not isinstance(response, expected):
-            reason = getattr(response, "reason", repr(response))
-            raise RuntimeError(
-                f"master error for {type(request).__name__}: {reason}"
-            )
-        return response
+        return self._typed(self._report, request, expected)
 
     def close(self) -> None:
         self._channel.close()

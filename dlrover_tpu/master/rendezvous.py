@@ -57,10 +57,11 @@ class RendezvousManager:
         self._latest_world: Dict[int, int] = {}   # node_rank -> local_world
         self._latest_round_start = 0.0
         self._node_ips: Dict[int, str] = {}
-        # True between "a member of the latest world died" and "a fresh
-        # round was cut": the stale world must never be handed out, and
-        # healthy survivors must be told to restart (membership change).
-        self._world_invalidated = False
+        # Survivors of an invalidated world that have not yet re-joined.
+        # The membership-change signal stays raised (level-triggered) until
+        # every one of them re-joins or dies — a survivor whose poll missed
+        # the first window must still be told to restart.
+        self._pending_rejoin: set = set()
 
     # -- membership (driven by the node manager / event callbacks) --------
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
@@ -83,6 +84,7 @@ class RendezvousManager:
         with self._lock:
             self._alive_nodes.discard(node_rank)
             self._waiting.pop(node_rank, None)
+            self._pending_rejoin.discard(node_rank)
             if not graceful and node_rank in self._latest_world:
                 # A member of the cut round died: any survivor handed this
                 # world would only find out at jax.distributed.initialize
@@ -93,8 +95,10 @@ class RendezvousManager:
                     "invalidating the world", self.name, node_rank,
                     self._rdzv_round - 1,
                 )
+                self._pending_rejoin |= (
+                    set(self._latest_world) - {node_rank}
+                )
                 self._latest_world = {}
-                self._world_invalidated = True
                 self._on_world_invalidated()
 
     def _on_world_invalidated(self) -> None:
@@ -109,6 +113,7 @@ class RendezvousManager:
             self._waiting[node_rank] = _WaitingNode(node_rank,
                                                     local_world_size)
             self._alive_nodes.add(node_rank)
+            self._pending_rejoin.discard(node_rank)
             if node_ip:
                 self._node_ips[node_rank] = node_ip
             if len(self._waiting) == 1:
@@ -134,9 +139,10 @@ class RendezvousManager:
         """Agents restart workers when >0 while healthy (membership change;
         reference: training.py:483-486)."""
         with self._lock:
-            if self._world_invalidated:
-                # A world member died: healthy survivors must restart and
-                # re-join even before anyone reaches the waiting list.
+            if self._pending_rejoin:
+                # A world member died: every survivor must restart and
+                # re-join; keep the signal raised until each has done so
+                # (or died), however late its poll arrives.
                 return max(1, len(self._waiting))
             # Before the first round there is no world to change.
             if not self._latest_world:
@@ -181,7 +187,6 @@ class RendezvousManager:
         for rank in chosen:
             del self._waiting[rank]
         self._rdzv_round += 1
-        self._world_invalidated = False
         logger.info(
             "%s rendezvous round %d completed: world=%s",
             self.name, self._rdzv_round - 1, sorted(self._latest_world),

@@ -217,6 +217,27 @@ class TestRendezvousOverflow:
         # Signal clears once the fresh round is cut.
         assert mgr.num_nodes_waiting() == 0
 
+    def test_restart_signal_is_level_triggered_per_survivor(self):
+        """A survivor whose num_nodes_waiting poll misses the first window
+        must STILL see the restart signal after a fresh round was cut by
+        faster survivors — otherwise its worker hangs on the dead world."""
+        mgr = make_mgr(1, 3, wait=0.0)
+        for rank in range(3):
+            mgr.join_rendezvous(rank, 4)
+        _, _, world = mgr.get_comm_world(0)
+        assert set(world) == {0, 1, 2}
+        mgr.remove_alive_node(2)          # node 2 dies
+        mgr.join_rendezvous(0, 4)         # fast survivor re-joins…
+        _, _, w = mgr.get_comm_world(0)   # …and a fresh round cuts
+        assert set(w) == {0}
+        # Slow survivor 1 polls only now: the signal must still be raised.
+        assert mgr.num_nodes_waiting() > 0
+        mgr.join_rendezvous(1, 4)         # it re-joins → signal clears
+        mgr.join_rendezvous(0, 4)
+        _, _, w = mgr.get_comm_world(1)
+        assert set(w) == {0, 1}
+        assert mgr.num_nodes_waiting() == 0
+
     def test_graceful_exit_keeps_world_valid(self):
         """A node finishing cleanly must NOT invalidate the world: the
         survivors are finishing their own work and must not be told to
