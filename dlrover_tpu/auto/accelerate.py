@@ -110,6 +110,11 @@ def lower(context: ModelContext) -> AccelerateResult:
     if plan.flash_attention:
         updates["attn_impl"] = (
             "flash" if jax.default_backend() == "tpu" else "reference")
+    if plan.sequence_parallel and mesh.shape[MeshAxis.SEQUENCE] > 1:
+        # SP replaces the attention kernel: the sequence dim is sharded, so
+        # attention must be the ring/all-to-all implementation (wins over a
+        # flash_attention request — the Pallas kernel needs the full seq).
+        updates["attn_impl"] = plan.sequence_impl
     if plan.remat:
         updates["remat"] = True
         if plan.remat_policy:

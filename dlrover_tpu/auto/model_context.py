@@ -28,6 +28,8 @@ class AccelerationPlan:
     fsdp: bool = False
     tensor_parallel: bool = False
     sequence_parallel: bool = False
+    # "ring" (ppermute KV rotation) | "ulysses" (all-to-all head parallel)
+    sequence_impl: str = "ring"
     expert_parallel: bool = False
     pipeline_stages: int = 1
     compute_dtype: Optional[Any] = None      # jnp.bfloat16 for half/amp

@@ -129,6 +129,11 @@ class SequenceParallelOptimization(Optimization):
 
     def apply(self, context, config):
         context.plan.sequence_parallel = True
+        impl = config.get("impl", "ring")
+        if impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sequence_parallel impl must be ring|ulysses, got {impl!r}")
+        context.plan.sequence_impl = impl
         _set_mesh_dim(context, MeshAxis.SEQUENCE,
                       int(config.get("size", 2)))
 

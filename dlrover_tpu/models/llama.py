@@ -43,7 +43,12 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16        # activation/compute dtype
     param_dtype: Any = jnp.float32   # master parameter dtype
-    attn_impl: str = "flash"         # "flash" | "reference"
+    # "flash" (Pallas kernel) | "reference" (XLA) | "ring" (sequence-
+    # parallel ppermute KV rotation) | "ulysses" (sequence-parallel
+    # all-to-all head dispatch). ring/ulysses shard the sequence dim over
+    # the mesh's `sequence` axis (parallel/ring_attention.py) and need the
+    # ambient mesh build_trainer provides at trace time.
+    attn_impl: str = "flash"
     # "onehot": iota/one-hot matmul lookup — SPMD-partitions as a plain
     # matmul, so the embedding-table gradient never hits the scatter path
     # that forces XLA into involuntary full rematerialization on a
@@ -148,6 +153,42 @@ def apply_rope(x: jax.Array, positions: jax.Array,
     return out.astype(x.dtype)
 
 
+def _sequence_parallel_mesh():
+    """The ambient mesh when it has an active sequence axis, else None
+    (→ the caller falls back to plain attention)."""
+    from dlrover_tpu.common.constants import MeshAxis
+    from dlrover_tpu.parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None or mesh.shape.get(MeshAxis.SEQUENCE, 1) == 1:
+        return None
+    return mesh
+
+
+def _sequence_parallel_attention(impl, mesh, q, k, v):
+    """Dispatch to ring/Ulysses attention on (b, seq, heads, dim) arrays;
+    k/v carry the (smaller) GQA head count — the kernels replicate heads
+    after sharding so only KV-sized bytes ride the ICI.
+
+    Capability parity: atorch DistributedSelfAttention wired into the real
+    transformer blocks (distributed_attention.py:21-115, commu_utils.py:6,47)
+    — here the model reaches the sequence-parallel kernels directly via
+    `attn_impl`, with the mesh taken from the ambient context that
+    build_trainer establishes at trace time."""
+    from dlrover_tpu.common.constants import MeshAxis
+    from dlrover_tpu.parallel.ring_attention import (
+        ring_attention,
+        ulysses_attention,
+    )
+
+    head_axis = (MeshAxis.TENSOR
+                 if mesh.shape.get(MeshAxis.TENSOR, 1) > 1 else None)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, mesh, causal=True,
+                                 head_axis=head_axis)
+    return ring_attention(q, k, v, mesh, causal=True, head_axis=head_axis)
+
+
 class Attention(nn.Module):
     config: LlamaConfig
 
@@ -170,13 +211,25 @@ class Attention(nn.Module):
         v = v.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        # (b, heads, seq, dim) layout for the kernel
-        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-        if cfg.attn_impl == "flash":
-            out = flash_attention(q, k, v, True)
+        impl = cfg.attn_impl
+        sp_mesh = None
+        if impl in ("ring", "ulysses"):
+            sp_mesh = _sequence_parallel_mesh()
+            if sp_mesh is None:
+                # Off-mesh (unit runs) or no sequence axis: fall back to
+                # the plain path below rather than a degenerate shard_map.
+                impl = "reference"
+        if sp_mesh is not None:
+            out = _sequence_parallel_attention(impl, sp_mesh, q, k, v)
+            out = out.reshape(batch, seq, -1)
         else:
-            out = reference_attention(q, k, v, True)
-        out = out.transpose(0, 2, 1, 3).reshape(batch, seq, -1)
+            # (b, heads, seq, dim) layout for the kernel
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            if impl == "flash":
+                out = flash_attention(q, k, v, True)
+            else:
+                out = reference_attention(q, k, v, True)
+            out = out.transpose(0, 2, 1, 3).reshape(batch, seq, -1)
         return dense("o_proj",
                      (cfg.num_heads * cfg.head_dim, cfg.hidden_size),
                      ("heads", "embed"))(out)

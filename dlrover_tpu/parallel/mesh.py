@@ -87,6 +87,21 @@ def create_mesh(spec: Optional[MeshSpec] = None,
     return Mesh(array, names)
 
 
+def current_mesh() -> Optional[Mesh]:
+    """The ambient physical mesh (set by ``with mesh:``), or None.
+
+    Model code that needs a concrete mesh for an inner ``shard_map``
+    (ring/Ulysses attention) reads it from here at trace time —
+    build_trainer enters the mesh context around tracing, so the model
+    never has to carry the mesh through its config."""
+    from jax._src import mesh as mesh_lib  # no public accessor yet
+
+    physical = mesh_lib.thread_resources.env.physical_mesh
+    if physical.devices.size:
+        return physical
+    return None
+
+
 def data_axes(mesh: Mesh) -> Tuple[str, ...]:
     """Axes the batch dim is sharded over (data + fsdp jointly, the
     standard ZeRO-3 layout)."""

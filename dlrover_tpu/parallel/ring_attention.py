@@ -41,7 +41,8 @@ _NEG_INF = -1e30
 def _block_attn(q, k, v, scale, mask):
     """One Q-shard × KV-block: returns (unnorm_out, block_max, block_sum).
 
-    q: (B, Lq, H, D), k/v: (B, Lk, H, D), mask: (Lq, Lk) additive or None.
+    q: (B, Lq, H, D), k/v: (B, Lk, H, D) (GQA callers repeat KV heads to H
+    before this), mask: (Lq, Lk) additive or None.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
@@ -70,10 +71,14 @@ def _online_merge(o, m, l, o_new, m_new, l_new):
 
 def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
                      scale: float):
-    """Per-device body under shard_map. q/k/v: (B, L_local, H, D)."""
+    """Per-device body under shard_map. q: (B, L_local, H, D); k/v may
+    carry fewer (GQA) heads — only the small KV shards rotate around the
+    ring; the head replication happens locally per block, so ppermute
+    traffic is not multiplied by the group count."""
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     batch, l_local, heads, dim = q.shape
+    groups = heads // k.shape[2]
 
     q32 = q.astype(jnp.float32)
     positions_q = my_idx * l_local + jnp.arange(l_local)
@@ -88,7 +93,12 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
             ).astype(jnp.float32)
         else:
             mask = None
-        o_new, m_new, l_new = _block_attn(q32, k_blk, v_blk, scale, mask)
+        if groups > 1:
+            k_rep = jnp.repeat(k_blk, groups, axis=2)
+            v_rep = jnp.repeat(v_blk, groups, axis=2)
+        else:
+            k_rep, v_rep = k_blk, v_blk
+        o_new, m_new, l_new = _block_attn(q32, k_rep, v_rep, scale, mask)
         o, m, l = _online_merge(o, m, l, o_new, m_new, l_new)
         # rotate K/V to the next device; the permute of step i+1 overlaps
         # this step's matmuls (independent DMA)
@@ -118,9 +128,10 @@ def ring_attention(
     batch_axes=(MeshAxis.DATA, MeshAxis.FSDP),
     head_axis: Optional[str] = MeshAxis.TENSOR,
 ) -> jax.Array:
-    """Full-array API: q/k/v (B, S, H, D) sharded S over `axis`; returns
-    the attention output with the same sharding. Composes with tensor
-    parallelism (heads over `head_axis`) in one shard_map."""
+    """Full-array API: q (B, S, H, D), k/v (B, S, KV, D) with KV ≤ H (GQA),
+    all sharded S over `axis`; returns the attention output with q's
+    sharding. Composes with tensor parallelism (heads over `head_axis`)
+    in one shard_map."""
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     spec = P(batch_axes, axis, head_axis, None)
     fn = shard_map(
@@ -141,7 +152,11 @@ def ring_attention(
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     """Per-device body: (B, L_local, H, D) → all_to_all → full-seq
-    attention on H/axis_size heads → all_to_all back."""
+    attention on H/axis_size heads → all_to_all back.
+
+    GQA: when the KV head count divides the axis size, the SMALL k/v
+    arrays ride the all_to_all and heads are replicated after (ICI moves
+    KV-sized bytes, not H-sized); otherwise KV is replicated up front."""
     axis_size = lax.psum(1, axis_name)
 
     def seq_to_heads(x):
@@ -153,9 +168,20 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
+    if k.shape[2] % axis_size:
+        groups = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
     q_full = seq_to_heads(q)
     k_full = seq_to_heads(k)
     v_full = seq_to_heads(v)
+    rep = q_full.shape[2] // k_full.shape[2]
+    if rep > 1:
+        # local q heads j map to local kv head j // rep — the same
+        # assignment as a global pre-split repeat, since contiguous head
+        # blocks land on each device
+        k_full = jnp.repeat(k_full, rep, axis=2)
+        v_full = jnp.repeat(v_full, rep, axis=2)
     l_full = q_full.shape[1]
     mask = None
     if causal:
@@ -177,17 +203,26 @@ def ulysses_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     batch_axes=(MeshAxis.DATA, MeshAxis.FSDP),
+    head_axis: Optional[str] = None,
 ) -> jax.Array:
-    """All-to-all sequence parallelism (heads must divide the axis size).
-    Lower latency than the ring for moderate sequence lengths: 2
-    all-to-alls instead of axis_size permutes."""
+    """All-to-all sequence parallelism. q (B, S, H, D), k/v may carry
+    fewer (GQA) heads. Lower latency than the ring for moderate sequence
+    lengths: 2 all-to-alls instead of axis_size permutes. With
+    `head_axis` (tensor parallelism) the per-device head group is divided
+    again by the sequence axis, composing SP × TP in one shard_map."""
     heads = q.shape[2]
     axis_size = mesh.shape[axis]
-    if heads % axis_size:
+    tensor_size = mesh.shape[head_axis] if head_axis else 1
+    if heads % (axis_size * tensor_size):
         raise ValueError(
-            f"{heads} heads not divisible by sequence axis {axis_size}")
+            f"{heads} heads not divisible by sequence axis {axis_size}"
+            + (f" × tensor axis {tensor_size}" if tensor_size > 1 else ""))
+    if head_axis and k.shape[2] % tensor_size:
+        raise ValueError(
+            f"{k.shape[2]} kv heads not divisible by tensor axis "
+            f"{tensor_size}")
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
-    spec = P(batch_axes, axis, None, None)
+    spec = P(batch_axes, axis, head_axis, None)
     fn = shard_map(
         functools.partial(_ulysses_local, axis_name=axis, causal=causal,
                           scale=scale),

@@ -92,25 +92,32 @@ def build_trainer(
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           opt_state=opt_state)
 
-    rng_spec = jax.eval_shape(lambda: jax.random.PRNGKey(0))
-    abstract_boxed = jax.eval_shape(
-        _init_boxed, jax.random.key(0)
-    )
+    # The mesh context is entered INSIDE every traced function so model
+    # code can reach the concrete mesh at trace time (current_mesh() —
+    # ring/Ulysses attention build an inner shard_map from it), including
+    # re-traces from eval_shape in the checkpoint-restore path.
+    with mesh:
+        abstract_boxed = jax.eval_shape(
+            _init_boxed, jax.random.key(0)
+        )
     state_shardings = mesh_shardings(abstract_boxed, mesh, rules)
+    # Batch (accum, micro, seq): micro over the joint dp axes, seq over the
+    # sequence axis (a no-op at sequence=1; shards inputs for SP runs).
     batch_shard = NamedSharding(
-        mesh, P(None, (MeshAxis.DATA, MeshAxis.FSDP))
+        mesh, P(None, (MeshAxis.DATA, MeshAxis.FSDP), MeshAxis.SEQUENCE)
     )
 
-    init_fn = jax.jit(
-        lambda rng: nn.unbox(_init_boxed(rng)),
-        out_shardings=state_shardings,
-    )
+    def _init(rng):
+        with mesh:
+            return nn.unbox(_init_boxed(rng))
+
+    init_fn = jax.jit(_init, out_shardings=state_shardings)
 
     def _train_step(state: TrainState, tokens, targets):
         # activation logical-constraints in the models resolve through
         # these rules (no-ops without this context); with-block so a
         # trace-time exception never leaks flax's global rules stack
-        with nn.logical_axis_rules(rules):
+        with mesh, nn.logical_axis_rules(rules):
             return _train_step_body(state, tokens, targets)
 
     def _train_step_body(state: TrainState, tokens, targets):
