@@ -88,6 +88,7 @@ class JobMaster:
         self.auto_scaler = None
         if job_manager is None and job_args is not None:
             from dlrover_tpu.master.node.event_callback import (
+                PsFailoverCallback,
                 RendezvousMembershipCallback,
                 TaskRescheduleCallback,
             )
@@ -101,6 +102,8 @@ class JobMaster:
             manager.add_event_callback(
                 RendezvousMembershipCallback(self.rdzv_managers,
                                              self.speed_monitor))
+            manager.add_event_callback(
+                PsFailoverCallback(self.elastic_ps_service))
             self.job_manager = manager
             self.servicer.job_manager = manager
             self._attach_optimization(job_args, brain_addr)

@@ -42,6 +42,46 @@ class TaskRescheduleCallback(NodeEventCallback):
         self._task_manager.recover_tasks(node.id)
 
 
+class PsFailoverCallback(NodeEventCallback):
+    """Bump the global cluster version when a state-holding node dies.
+
+    Capability parity: TFPSNodeHandlingCallback (reference
+    master/node/event_callback.py:127) driving ElasticPsService
+    (elastic_training/elastic_ps.py:18): the version bump is what tells
+    every worker its view of the sharded state is stale. TPU reframing:
+    there are no PS processes — every worker holds embedding-table shards,
+    so any state-holder death advances the version and workers reconcile
+    by restoring the table from the latest committed checkpoint
+    (trainer/embedding.py EmbeddingFailoverClient)."""
+
+    def __init__(self, elastic_ps_service, node_types=("worker", "ps")):
+        self._service = elastic_ps_service
+        self._node_types = set(node_types)
+
+    def _bump(self, node: Node) -> None:
+        if node.type in self._node_types:
+            self._service.remove_node(node.type, node.id)
+            version = self._service.inc_global_cluster_version()
+            logger.info(
+                "state holder %s died: global cluster version -> %d",
+                node.name, version,
+            )
+
+    def on_node_failed(self, node: Node) -> None:
+        self._bump(node)
+
+    def on_node_deleted(self, node: Node) -> None:
+        from dlrover_tpu.common.constants import NodeStatus
+
+        # Only an unexpected deletion of a live node is a state loss; a
+        # SUCCEEDED pod's cleanup is routine, and a FAILED node already
+        # bumped the version on the failure event (no double rollback).
+        if node.status == NodeStatus.RUNNING:
+            self._bump(node)
+        elif node.type in self._node_types:
+            self._service.remove_node(node.type, node.id)
+
+
 class RendezvousMembershipCallback(NodeEventCallback):
     """Keep rendezvous managers' alive-node sets and the speed monitor in
     sync with node lifecycle (the AllReduce path's membership bookkeeping)."""

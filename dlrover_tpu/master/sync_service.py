@@ -83,6 +83,12 @@ class ElasticPsService:
             elif version_type == self.RESTORED:
                 self._restored_version = version
 
+    def remove_node(self, task_type: str, task_id: int) -> None:
+        """Drop a dead node's published local version so cluster-wide
+        reconciliation checks never wait on it."""
+        with self._lock:
+            self._node_versions.get(task_type, {}).pop(task_id, None)
+
     def get_cluster_version(self, version_type: str, task_type: str,
                             task_id: int) -> int:
         with self._lock:

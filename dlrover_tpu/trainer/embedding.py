@@ -64,6 +64,81 @@ EMBEDDING_RULES = [
 ]
 
 
+@dataclasses.dataclass
+class ReconcileResult:
+    """Outcome of ElasticEmbeddingTrainer.maybe_reconcile: the (possibly
+    restored) state, whether a restore happened, and the checkpoint's step
+    and data position — the caller must roll its step counter and sampler
+    back with the parameters."""
+
+    state: Any
+    reconciled: bool
+    step: int = 0
+    data_state: Any = None
+
+
+class EmbeddingFailoverClient:
+    """Worker-side cluster-version arbitration.
+
+    Capability parity: FailoverClient
+    (trainer/tensorflow/failover/failover_client.py:21) +
+    TensorflowFailover (:91-144): the worker adopts the global version at
+    start, publishes it as its local version, and watches for the global
+    version to advance past it — the master's PsFailoverCallback bumps it
+    when a state holder dies. A lagging local version means this worker's
+    view of the sharded state is stale and it must reconcile (restore from
+    the latest committed checkpoint) before training on.
+    """
+
+    def __init__(self, master_client, task_type: str = "worker"):
+        self._client = master_client
+        self._task_type = task_type
+        self.local_version = 0
+
+    def start(self) -> int:
+        """Adopt the current global version and publish it as local."""
+        self.local_version = self._client.get_cluster_version(
+            "global", self._task_type)
+        self._client.update_cluster_version(
+            "local", self.local_version, self._task_type)
+        return self.local_version
+
+    def needs_reconcile(self) -> bool:
+        return (self._client.get_cluster_version("global", self._task_type)
+                > self.local_version)
+
+    def complete_reconcile(self) -> int:
+        """Adopt the (possibly again-advanced) global version after a
+        successful restore and publish it."""
+        self.local_version = self._client.get_cluster_version(
+            "global", self._task_type)
+        self._client.update_cluster_version(
+            "local", self.local_version, self._task_type)
+        return self.local_version
+
+    def wait_reconciled_cluster(self, task_ids, timeout_s: float = 60.0
+                                ) -> bool:
+        """Block until every LIVE worker's published local version has
+        caught up with the global version (the reference's sync-barrier
+        around PS migration). ``task_ids`` is the live membership — take
+        it from the current rendezvous world, NOT a count: relaunched
+        nodes get fresh ids, so positional ranges would poll the dead."""
+        import time as _time
+
+        deadline = _time.time() + timeout_s
+        while _time.time() < deadline:
+            global_v = self._client.get_cluster_version(
+                "global", self._task_type)
+            locals_ok = all(
+                self._client.get_cluster_version(
+                    "local", self._task_type, task_id=i) >= global_v
+                for i in task_ids)
+            if locals_ok:
+                return True
+            _time.sleep(0.1)
+        return False
+
+
 class ElasticEmbeddingTrainer:
     """PS-style training loop core: sparse embedding + dense tower.
 
@@ -133,3 +208,40 @@ class ElasticEmbeddingTrainer:
             return embed_params, embed_opt, dense_params, dense_opt, loss
 
         return step
+
+    def maybe_reconcile(self, failover: EmbeddingFailoverClient,
+                        checkpointer, state) -> "ReconcileResult":
+        """The failover workflow the reference drives from
+        tensorflow_failover.py:91-144, TPU-reframed: when the global
+        cluster version advanced past this worker's local version (a
+        state holder died), restore (embed_params, embed_opt,
+        dense_params, dense_opt) from the latest committed checkpoint
+        into the live shardings, adopt the version, and publish it.
+
+        Call between steps; training must not proceed on a stale view
+        once `needs_reconcile()` is true. The result carries the
+        checkpoint's step and data_state so the caller rolls its step
+        counter and sampler position back with the parameters. If no
+        committed checkpoint exists, NOTHING is published (the worker
+        stays marked stale) and `reconciled` is False — the caller
+        should keep retrying or escalate.
+        """
+        if not failover.needs_reconcile():
+            return ReconcileResult(state=state, reconciled=False)
+        abstract = jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=leaf.sharding),
+            state,
+        )
+        restored = checkpointer.restore(abstract)
+        if restored is None:
+            from dlrover_tpu.common.log import default_logger as logger
+
+            logger.warning(
+                "reconcile needed (global version ahead) but no committed "
+                "checkpoint exists; staying stale")
+            return ReconcileResult(state=state, reconciled=False)
+        state, data_state, step = restored
+        failover.complete_reconcile()
+        return ReconcileResult(state=state, reconciled=True, step=step,
+                               data_state=data_state)

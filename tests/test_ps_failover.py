@@ -1,0 +1,188 @@
+"""PS failover end-to-end: a state-holder death bumps the global cluster
+version, workers detect the stale view, restore the sharded embedding table
+from the latest committed checkpoint, and publish their local version.
+
+Reference workflow: elastic_ps.py:18 cluster versions consumed by
+tensorflow_failover.py:91-144 (watch version change -> rebuild from
+checkpoint), bumped by TFPSNodeHandlingCallback (event_callback.py:127).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.checkpoint import FlashCheckpointer
+from dlrover_tpu.common.constants import NodeExitReason, NodeType
+from dlrover_tpu.master.job_master import JobMaster
+from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
+from dlrover_tpu.scheduler.local import LocalCluster
+from dlrover_tpu.trainer.embedding import (
+    ElasticEmbeddingTrainer,
+    EmbeddingConfig,
+    EmbeddingFailoverClient,
+    ShardedEmbedding,
+)
+from tests.test_job_manager import make_job_args, wait_until
+
+
+def _make_trainer(cpu_devices):
+    mesh = create_mesh(MeshSpec(fsdp=4), cpu_devices[:4])
+    embedding = ShardedEmbedding(EmbeddingConfig(vocab_size=64, embed_dim=8))
+    dense_apply = lambda w, emb: emb @ w
+    loss_fn = lambda preds, labels: jnp.mean((preds - labels) ** 2)
+    trainer = ElasticEmbeddingTrainer(mesh, embedding, dense_apply, loss_fn)
+    return trainer
+
+
+def _step_data(rng):
+    ids = rng.integers(0, 64, (16,), dtype=np.int32)
+    labels = rng.standard_normal((16, 1)).astype(np.float32)
+    return ids, labels
+
+
+def test_ps_failover_restores_consistent_table(tmp_path, cpu_devices):
+    cluster = LocalCluster()
+    master = JobMaster(min_nodes=2, max_nodes=2,
+                       job_args=make_job_args(workers=2),
+                       cluster=cluster, host="127.0.0.1")
+    master.prepare()
+    client = MasterClient(master.addr, node_id=0, node_rank=0)
+    try:
+        assert wait_until(
+            lambda: len(master.job_manager.get_running_workers()) == 2)
+
+        trainer = _make_trainer(cpu_devices)
+        rng = np.random.default_rng(3)
+        dense0 = jnp.zeros((8, 1), jnp.float32)
+        embed_params, embed_opt, dense_opt = trainer.init(
+            jax.random.PRNGKey(0), jnp.zeros((4,), jnp.int32), dense0)
+        state = (embed_params, embed_opt, dense0, dense_opt)
+        step = trainer.build_step()
+
+        failover = EmbeddingFailoverClient(client)
+        assert failover.start() == 0
+
+        with FlashCheckpointer(str(tmp_path / "ckpt"),
+                               save_interval_steps=1) as ckpt:
+            # Train 3 steps, checkpoint after each; remember the committed
+            # table.
+            for i in range(1, 4):
+                ids, labels = _step_data(rng)
+                *state, loss = step(*state, ids, labels)
+                ckpt.maybe_save(i, tuple(state))
+            ckpt.wait()
+            state = tuple(state)
+            committed_table = np.asarray(state[0]["table"])
+
+            # A state holder dies -> PsFailoverCallback bumps the global
+            # version.
+            victim = master.job_manager.get_running_workers()[0]
+            cluster.fail_pod(victim.name, NodeExitReason.UNKNOWN_ERROR)
+            assert wait_until(
+                lambda: client.get_cluster_version("global") >= 1)
+
+            # This worker diverges (uncheckpointed steps on a stale view).
+            for _ in range(2):
+                ids, labels = _step_data(rng)
+                *state, loss = step(*state, ids, labels)
+            state = tuple(state)
+            assert not np.allclose(np.asarray(state[0]["table"]),
+                                   committed_table)
+
+            # Reconcile: restore the committed table, adopt + publish the
+            # version, roll the step counter back to the checkpoint's.
+            assert failover.needs_reconcile()
+            result = trainer.maybe_reconcile(failover, ckpt, state)
+            assert result.reconciled
+            assert result.step == 3      # rolled back to the commit point
+            state = result.state
+            np.testing.assert_array_equal(
+                np.asarray(state[0]["table"]), committed_table)
+            assert failover.local_version == client.get_cluster_version(
+                "global")
+            # The published local version is visible master-side.
+            assert client.get_cluster_version(
+                "local", task_id=0) == failover.local_version
+            # With the single live worker published, the cluster reads as
+            # reconciled (live membership by id, not positional count).
+            assert failover.wait_reconciled_cluster(
+                task_ids=[0], timeout_s=5)
+            # No further reconcile needed.
+            assert not trainer.maybe_reconcile(failover, ckpt,
+                                               state).reconciled
+    finally:
+        client.close()
+        master.stop()
+
+
+def test_reconcile_without_checkpoint_stays_stale(tmp_path, cpu_devices):
+    """No committed checkpoint -> nothing is published and the worker
+    stays marked stale (no silent 'reconciled' lie)."""
+    master = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    master.prepare()
+    client = MasterClient(master.addr, node_id=0, node_rank=0)
+    try:
+        trainer = _make_trainer(cpu_devices)
+        dense0 = jnp.zeros((8, 1), jnp.float32)
+        embed_params, embed_opt, dense_opt = trainer.init(
+            jax.random.PRNGKey(0), jnp.zeros((4,), jnp.int32), dense0)
+        state = (embed_params, embed_opt, dense0, dense_opt)
+        failover = EmbeddingFailoverClient(client)
+        failover.start()
+        master.elastic_ps_service.inc_global_cluster_version()
+        with FlashCheckpointer(str(tmp_path / "empty"),
+                               save_interval_steps=1) as ckpt:
+            result = trainer.maybe_reconcile(failover, ckpt, state)
+        assert not result.reconciled
+        assert failover.needs_reconcile()          # still stale
+        assert client.get_cluster_version("local", task_id=0) == 0
+    finally:
+        client.close()
+        master.stop()
+
+
+def test_dead_node_version_entry_is_dropped():
+    """The master forgets a dead node's published local version, so
+    cluster-wide reconciliation never waits on it; clean pod cleanup does
+    not bump the version, and FAILED->DELETED does not double-bump."""
+    from dlrover_tpu.common.constants import NodeStatus
+    from dlrover_tpu.common.node import Node
+    from dlrover_tpu.master.node.event_callback import PsFailoverCallback
+    from dlrover_tpu.master.sync_service import ElasticPsService
+
+    service = ElasticPsService()
+    callback = PsFailoverCallback(service)
+    service.update_cluster_version("local", 5, "worker", 1)
+    node = Node(node_type=NodeType.WORKER, node_id=1)
+    node.status = NodeStatus.FAILED
+    callback.on_node_failed(node)
+    assert service.get_cluster_version("global", "worker", 0) == 1
+    assert service.get_cluster_version("local", "worker", 1) == 0
+    callback.on_node_deleted(node)                 # FAILED -> DELETED
+    assert service.get_cluster_version("global", "worker", 0) == 1
+    ok_node = Node(node_type=NodeType.WORKER, node_id=2)
+    ok_node.status = NodeStatus.SUCCEEDED
+    callback.on_node_deleted(ok_node)              # routine cleanup
+    assert service.get_cluster_version("global", "worker", 0) == 1
+    running = Node(node_type=NodeType.WORKER, node_id=3)
+    running.status = NodeStatus.RUNNING
+    callback.on_node_deleted(running)              # unexpected kill
+    assert service.get_cluster_version("global", "worker", 0) == 2
+
+
+def test_failover_client_noop_without_version_bump(cpu_devices):
+    master = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    master.prepare()
+    client = MasterClient(master.addr, node_id=0, node_rank=0)
+    try:
+        failover = EmbeddingFailoverClient(client)
+        failover.start()
+        assert not failover.needs_reconcile()
+    finally:
+        client.close()
+        master.stop()
