@@ -149,17 +149,19 @@ def lower(context: ModelContext) -> AccelerateResult:
     sample = context.infer_sample_batch(micro)
 
     if plan.pipeline_stages > 1:
+        from dlrover_tpu.models.gpt import GPTConfig
         from dlrover_tpu.models.llama import LlamaConfig
         from dlrover_tpu.trainer.pipeline_trainer import (
             build_pipeline_trainer,
         )
 
         cfg = context.model_config()
-        if not isinstance(cfg, LlamaConfig):
+        if not isinstance(cfg, (LlamaConfig, GPTConfig)):
             raise NotImplementedError(
-                "pipeline lowering needs a stacked-decoder model "
-                "(LlamaConfig family); for custom models call "
-                "dlrover_tpu.parallel.pipeline.pipeline_apply directly")
+                "pipeline lowering needs a stacked-block model config "
+                "(LlamaConfig or GPTConfig); for custom models build a "
+                "PipelineModelSpec and a PipelinedTrainer directly "
+                "(dlrover_tpu.trainer.pipeline_trainer)")
         if plan.global_batch:
             # the accumulation geometry IS the microbatch stream: the
             # user's global batch is authoritative (accum × micro rows)
@@ -171,6 +173,7 @@ def lower(context: ModelContext) -> AccelerateResult:
             num_microbatches=num_micro, micro_batch=micro,
             seq_len=np.asarray(sample).shape[-1],
             loss_fn=context.loss_fn, remat=plan.remat,
+            num_rounds=plan.pipeline_rounds,
             rules=rules,
         )
         return AccelerateResult(trainer=trainer, mesh=mesh,

@@ -32,6 +32,8 @@ class AccelerationPlan:
     sequence_impl: str = "ring"
     expert_parallel: bool = False
     pipeline_stages: int = 1
+    # circular (interleaved) schedule: layer chunks per stage; 1 = GPipe
+    pipeline_rounds: int = 1
     compute_dtype: Optional[Any] = None      # jnp.bfloat16 for half/amp
     params_dtype: Optional[Any] = None       # fp32 master params when amp
     remat: bool = False

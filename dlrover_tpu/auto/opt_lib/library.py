@@ -159,6 +159,8 @@ class PipelineParallelOptimization(Optimization):
     def apply(self, context, config):
         size = int(config.get("size", 2))
         context.plan.pipeline_stages = size
+        # rounds > 1 = circular/interleaved schedule (bubble ÷ rounds)
+        context.plan.pipeline_rounds = int(config.get("rounds", 1))
         _set_mesh_dim(context, MeshAxis.PIPE, size)
 
 

@@ -1,23 +1,30 @@
-"""Pipelined transformer trainer: GPipe over stacked decoder layers.
+"""Pipelined transformer trainer: circular-schedule PP over layer chunks.
 
 Capability parity: atorch's pipeline-parallel training path (PiPPy
-compile → stages → driver, distributed_pippy_compiler.py:378) and the
-DeepSpeed 3D composition (ds_3d_parallel_optimization.py:53 — pipe ×
-tensor × data in one topology). TPU re-design (scan-over-layers lineage):
-decoder-layer params are stacked (num_stages, layers_per_stage, ...) with
-the stage dim sharded over the `pipe` mesh axis AND their trailing dims
-sharded over fsdp/tensor through the model's logical axis names — the
-pipe shard_map is manual only over `pipe` (jax.shard_map axis_names), so
-XLA keeps the stage-internal shardings and inserts the intra-stage
-collectives. The forward runs the embedding, streams microbatch row
-shards through the stages (each data replica pipelines its own rows —
-PP × DP × FSDP/TP), then the LM head. Same init/step/shard_batch surface
-as build_trainer.
+compile → stages → driver with GPipe/interleaved/1F1B schedules,
+distributed_pippy_compiler.py:378) and the DeepSpeed 3D composition
+(ds_3d_parallel_optimization.py:53 — pipe × tensor × data in one
+topology); arbitrary fx-traceable models map here to any stacked-block
+model via PipelineModelSpec (Llama and GPT ship built in).
+
+TPU re-design: decoder-layer params are stacked (rounds, stages,
+layers_per_chunk, ...) with the stage dim sharded over the `pipe` mesh
+axis AND their trailing dims sharded over fsdp/tensor through the model's
+logical axis names — the pipe shard_map is manual only over `pipe`
+(jax.shard_map axis_names), so XLA keeps the stage-internal shardings and
+inserts the intra-stage collectives. The embedding runs at stage 0 and
+the norm + LM head + loss at the last stage INSIDE the pipeline
+(parallel/pipeline.py pipeline_train), so that work is not replicated
+across pipe ranks and only a scalar loss crosses stages. num_rounds > 1
+gives the circular (interleaved) schedule that divides the pipeline
+bubble by the round count. Same init/step/shard_batch surface as
+build_trainer.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Union
 
 import flax.linen as nn
 import jax
@@ -27,77 +34,221 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.common.constants import MeshAxis
-from dlrover_tpu.models.llama import DecoderBlock, LlamaConfig, embed_lookup
-from dlrover_tpu.parallel.pipeline import pipeline_apply
+from dlrover_tpu.models.gpt import Block as GPTBlock, GPTConfig
+from dlrover_tpu.models.llama import (
+    DecoderBlock,
+    LlamaConfig,
+    embed_lookup,
+)
+from dlrover_tpu.parallel.pipeline import pipeline_train
 from dlrover_tpu.parallel.sharding import DEFAULT_RULES
 from dlrover_tpu.trainer.train_step import TrainState
 
 _BATCH_AXES = (MeshAxis.DATA, MeshAxis.FSDP)
 
 
-def _init_llama_pipeline_params(cfg: LlamaConfig, num_stages: int,
-                                rng: jax.Array, sample_seq: int):
-    """Params: embed (V,H), stacked block params with leading
-    (num_stages, layers_per_stage, ...), final norm + head."""
-    if cfg.num_layers % num_stages:
-        raise ValueError(f"{cfg.num_layers} layers not divisible by "
-                         f"{num_stages} stages")
-    per_stage = cfg.num_layers // num_stages
+def _per_row(loss_fn):
+    """Lift a batch-mean loss (logits, targets) -> scalar into a per-row
+    vector loss (micro, seq, V), (micro, seq) -> (micro,): the pipeline
+    exit must not reduce across (sharded) rows."""
+
+    def row_losses(logits, targets):
+        return jax.vmap(
+            lambda lg, tg: loss_fn(lg[None], tg[None]))(logits, targets)
+
+    return row_losses
+
+
+@dataclasses.dataclass
+class PipelineModelSpec:
+    """Everything the pipeline needs to know about a stacked-block model.
+
+    The reference pipelines arbitrary fx-traceable models; the analog
+    here is any model expressible as enter → N identical blocks → exit.
+    """
+
+    num_layers: int
+    # init ONE block's params: (rng) -> params tree (unboxed)
+    init_layer: Callable[[jax.Array], Any]
+    # init the shared (non-stage) params: (rng) -> dict (embedding, head…)
+    init_shared: Callable[[jax.Array], Any]
+    # chunk_fn(stacked_layer_params, act) -> act: run this chunk's layers
+    chunk_fn: Callable[[Any, jax.Array], jax.Array]
+    # enter_fn(shared, tokens_micro) -> (micro, seq, H) activation
+    enter_fn: Callable[[Any, jax.Array], jax.Array]
+    # exit_fn(shared, act, targets_micro) -> (micro,) per-row losses
+    # (NO cross-row reduction — it runs inside a stage-divergent cond,
+    # see pipeline_train)
+    exit_fn: Callable[[Any, jax.Array, jax.Array], jax.Array]
+    # abstract ONE-layer boxed params (for shardings): () -> boxed tree
+    abstract_layer: Callable[[], Any]
+    # logical specs for the shared params: dict name -> P(logical axes)
+    shared_logical: Any
+
+
+# ---------------------------------------------------------------------------
+# Built-in specs: Llama family and GPT (nanogpt)
+# ---------------------------------------------------------------------------
+
+
+def llama_pipeline_spec(cfg: LlamaConfig, seq_len: int,
+                        loss_fn) -> PipelineModelSpec:
     block = DecoderBlock(cfg)
-    x = jnp.zeros((1, sample_seq, cfg.hidden_size), cfg.dtype)
-    positions = jnp.zeros((1, sample_seq), jnp.int32)
-    rngs = jax.random.split(rng, cfg.num_layers + 2)
+    x = jnp.zeros((1, seq_len, cfg.hidden_size), cfg.dtype)
+    positions0 = jnp.zeros((1, seq_len), jnp.int32)
+    # enter_fn runs once per pipeline STEP on every device (uniform
+    # where-select, pipeline_train docstring): the gather lookup is
+    # near-free there, the one-hot matmul is micro·seq·V·H per step.
+    cfg_embed = dataclasses.replace(cfg, embed_impl="gather")
 
-    def init_one(layer_rng):
-        return nn.unbox(block.init(layer_rng, x, positions))["params"]
+    def init_layer(rng):
+        return nn.unbox(block.init(rng, x, positions0))["params"]
 
-    stacked = jax.vmap(init_one)(rngs[:cfg.num_layers])
-    stacked = jax.tree.map(
-        lambda leaf: leaf.reshape((num_stages, per_stage)
-                                  + leaf.shape[1:]), stacked)
-    embed = jax.random.normal(rngs[-2],
-                              (cfg.vocab_size, cfg.hidden_size),
-                              cfg.param_dtype) * 0.02
-    head = jax.random.normal(rngs[-1],
-                             (cfg.hidden_size, cfg.vocab_size),
-                             cfg.param_dtype) * 0.02
-    norm = jnp.ones((cfg.hidden_size,), cfg.param_dtype)
-    return {"embed": embed, "stages": stacked, "final_norm": norm,
-            "lm_head": head}
+    def init_shared(rng):
+        r_embed, r_head = jax.random.split(rng)
+        return {
+            "embed": jax.random.normal(
+                r_embed, (cfg.vocab_size, cfg.hidden_size),
+                cfg.param_dtype) * 0.02,
+            "final_norm": jnp.ones((cfg.hidden_size,), cfg.param_dtype),
+            "lm_head": jax.random.normal(
+                r_head, (cfg.hidden_size, cfg.vocab_size),
+                cfg.param_dtype) * 0.02,
+        }
+
+    def chunk_fn(stacked, h):
+        positions = jnp.broadcast_to(jnp.arange(h.shape[1]), h.shape[:2])
+
+        def one_layer(carry, layer_params):
+            return block.apply({"params": layer_params}, carry,
+                               positions), None
+
+        h, _ = lax.scan(one_layer, h, stacked)
+        return h
+
+    def enter_fn(shared, tokens):
+        return embed_lookup(shared["embed"], tokens, cfg_embed)
+
+    row_losses = _per_row(loss_fn)
+
+    def exit_fn(shared, h, targets):
+        from dlrover_tpu.ops.norms import reference_rms_norm
+
+        h = reference_rms_norm(
+            h, shared["final_norm"].astype(jnp.float32), cfg.rms_norm_eps)
+        logits = jnp.dot(h.astype(cfg.dtype),
+                         shared["lm_head"].astype(cfg.dtype))
+        logits = logits.astype(jnp.float32)
+        return row_losses(logits, targets)
+
+    def abstract_layer():
+        return jax.eval_shape(
+            lambda r: block.init(r, x, positions0)["params"],
+            jax.random.PRNGKey(0))
+
+    return PipelineModelSpec(
+        num_layers=cfg.num_layers,
+        init_layer=init_layer,
+        init_shared=init_shared,
+        chunk_fn=chunk_fn,
+        enter_fn=enter_fn,
+        exit_fn=exit_fn,
+        abstract_layer=abstract_layer,
+        shared_logical={
+            "embed": ("vocab", "embed"),
+            "final_norm": ("norm",),
+            "lm_head": ("embed", "vocab"),
+        },
+    )
 
 
-def _stage_fn_factory(cfg: LlamaConfig):
-    block = DecoderBlock(cfg)
+def gpt_pipeline_spec(cfg: GPTConfig, seq_len: int,
+                      loss_fn) -> PipelineModelSpec:
+    block = GPTBlock(cfg)
+    x = jnp.zeros((1, seq_len, cfg.n_embd), cfg.dtype)
+    ln = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")
 
-    def stage_fn(stage_params, x):
-        # x: (micro, seq, hidden); stage_params leaves: (per_stage, ...)
-        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+    def init_layer(rng):
+        return nn.unbox(block.init(rng, x))["params"]
 
-        def one_layer(h, layer_params):
-            return block.apply({"params": layer_params}, h, positions), None
+    # enter_fn runs once per pipeline STEP on every device: force the
+    # cheap gather lookup (see llama_pipeline_spec).
+    cfg_embed = dataclasses.replace(cfg, embed_impl="gather")
 
-        x, _ = lax.scan(one_layer, x, stage_params)
-        return x
+    def init_shared(rng):
+        r_wte, r_wpe, r_ln = jax.random.split(rng, 3)
+        return {
+            "wte": jax.random.normal(
+                r_wte, (cfg.vocab_size, cfg.n_embd),
+                cfg.param_dtype) * 0.02,
+            "wpe": jax.random.normal(
+                r_wpe, (cfg.block_size, cfg.n_embd),
+                cfg.param_dtype) * 0.02,
+            "ln_f": nn.unbox(ln.init(r_ln, x))["params"],
+        }
 
-    return stage_fn
+    def chunk_fn(stacked, h):
+        def one_layer(carry, layer_params):
+            return block.apply({"params": layer_params}, carry), None
+
+        h, _ = lax.scan(one_layer, h, stacked)
+        return h
+
+    def enter_fn(shared, tokens):
+        seq = tokens.shape[-1]
+        return (embed_lookup(shared["wte"], tokens, cfg_embed)
+                + shared["wpe"].astype(cfg.dtype)[:seq])
+
+    row_losses = _per_row(loss_fn)
+
+    def exit_fn(shared, h, targets):
+        h = ln.apply({"params": shared["ln_f"]}, h)
+        # weight-tied LM head (as nanoGPT)
+        logits = jnp.dot(h, shared["wte"].astype(cfg.dtype).T)
+        return row_losses(logits.astype(jnp.float32), targets)
+
+    def abstract_layer():
+        return jax.eval_shape(
+            lambda r: block.init(r, x)["params"], jax.random.PRNGKey(0))
+
+    return PipelineModelSpec(
+        num_layers=cfg.n_layer,
+        init_layer=init_layer,
+        init_shared=init_shared,
+        chunk_fn=chunk_fn,
+        enter_fn=enter_fn,
+        exit_fn=exit_fn,
+        abstract_layer=abstract_layer,
+        shared_logical={
+            "wte": ("vocab", "embed"),
+            "wpe": (None, "embed"),
+            "ln_f": {"scale": ("norm",), "bias": ("norm",)},
+        },
+    )
 
 
-class PipelinedLlamaTrainer:
+# ---------------------------------------------------------------------------
+# Trainer
+# ---------------------------------------------------------------------------
+
+
+class PipelinedTrainer:
     """Same surface as ShardedTrainer (init/step/shard_batch)."""
 
-    def __init__(self, cfg: LlamaConfig, tx: optax.GradientTransformation,
+    def __init__(self, spec: PipelineModelSpec,
+                 tx: optax.GradientTransformation,
                  mesh: Mesh, num_microbatches: int, micro_batch: int,
-                 seq_len: int, loss_fn, remat: bool = False,
+                 seq_len: int, num_rounds: int = 1, remat: bool = False,
                  rules: Optional[Sequence] = None):
-        self.cfg = cfg
+        self.spec = spec
         self.mesh = mesh
         self.num_stages = mesh.shape[MeshAxis.PIPE]
+        self.num_rounds = num_rounds
         self.num_microbatches = num_microbatches
         self.micro_batch = micro_batch
         self.accum_steps = num_microbatches  # microbatches play this role
         self.seq_len = seq_len
         self._tx = tx
-        self._loss_fn = loss_fn
         self._remat = remat
         self._rules = list(rules if rules is not None else DEFAULT_RULES)
         # batch arrays: (M, micro, seq) with micro rows over the dp axes
@@ -105,45 +256,70 @@ class PipelinedLlamaTrainer:
         self.state_shardings = None
         self._step = None
 
+    @property
+    def num_chunks(self) -> int:
+        return self.num_stages * self.num_rounds
+
+    @property
+    def layers_per_chunk(self) -> int:
+        if self.spec.num_layers % self.num_chunks:
+            raise ValueError(
+                f"{self.spec.num_layers} layers not divisible by "
+                f"{self.num_chunks} chunks "
+                f"({self.num_stages} stages × {self.num_rounds} rounds)")
+        return self.spec.num_layers // self.num_chunks
+
     # -- params ---------------------------------------------------------
     def _param_shardings(self):
-        """NamedSharding tree matching the params dict: stage leaves get
-        P(pipe, None, *mesh-mapped logical axes) — stage-internal
+        """NamedSharding tree matching the params dict: chunk leaves get
+        P(None, pipe, None, *mesh-mapped logical axes) — stage-internal
         fsdp/tensor sharding composed with pipe (the reference's 3D
         topology, ds_3d_parallel_optimization.py:53)."""
-        cfg = self.cfg
-        block = DecoderBlock(cfg)
-        x = jnp.zeros((1, self.seq_len, cfg.hidden_size), cfg.dtype)
-        positions = jnp.zeros((1, self.seq_len), jnp.int32)
         from dlrover_tpu.parallel.sharding import mesh_shardings
 
-        boxed = jax.eval_shape(
-            lambda r: block.init(r, x, positions)["params"],
-            jax.random.PRNGKey(0))
-        block_shardings = mesh_shardings(boxed, self.mesh, self._rules)
-        stage_shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh,
-                                    P(MeshAxis.PIPE, None, *s.spec)),
-            block_shardings,
+        boxed = self.spec.abstract_layer()
+        layer_shardings = mesh_shardings(boxed, self.mesh, self._rules)
+        chunk_shardings = jax.tree.map(
+            lambda s: NamedSharding(
+                self.mesh, P(None, MeshAxis.PIPE, None, *s.spec)),
+            layer_shardings,
             is_leaf=lambda s: isinstance(s, NamedSharding),
         )
 
-        def from_logical(*names):
+        # Shared params (embedding / final norm / head) replicate over
+        # pipe but keep their fsdp/tensor shardings: the enter/exit
+        # bodies execute uniformly on every device (where-selected, see
+        # pipeline_train), so their auto-axis collectives are uniform.
+        def from_logical(names):
+            if isinstance(names, dict):
+                return {k: from_logical(v) for k, v in names.items()}
             sh = nn.logical_to_mesh_sharding(
                 P(*names), self.mesh, self._rules)
             return NamedSharding(self.mesh, sh.spec)
 
-        return {
-            "embed": from_logical("vocab", "embed"),
-            "stages": stage_shardings,
-            "final_norm": from_logical("norm"),
-            "lm_head": from_logical("embed", "vocab"),
-        }
+        shared = {name: from_logical(names)
+                  for name, names in self.spec.shared_logical.items()}
+        return {"shared": shared, "chunks": chunk_shardings}
+
+    def _make_params(self, rng):
+        spec = self.spec
+        per_chunk = self.layers_per_chunk
+        r_layers, r_shared = jax.random.split(rng)
+        rngs = jax.random.split(r_layers, spec.num_layers)
+        stacked = jax.vmap(spec.init_layer)(rngs)
+        # layer ℓ = (r·S + s)·per_chunk + j  ↔  [r, s, j] (row-major)
+        stacked = jax.tree.map(
+            lambda leaf: leaf.reshape(
+                (self.num_rounds, self.num_stages, per_chunk)
+                + leaf.shape[1:]),
+            stacked)
+        return {"shared": spec.init_shared(r_shared), "chunks": stacked}
 
     def init(self, rng: jax.Array) -> TrainState:
+        _ = self.layers_per_chunk   # validate divisibility eagerly
+
         def make_state(rng):
-            params = _init_llama_pipeline_params(
-                self.cfg, self.num_stages, rng, self.seq_len)
+            params = self._make_params(rng)
             return TrainState(step=jnp.zeros((), jnp.int32),
                               params=params,
                               opt_state=self._tx.init(params))
@@ -184,33 +360,20 @@ class PipelinedLlamaTrainer:
         return put(tokens), put(targets)
 
     # -- step -----------------------------------------------------------
-    def _forward(self, params, tokens):
-        cfg = self.cfg
-        x = embed_lookup(params["embed"], tokens, cfg)  # (M, mb, S, H)
-        out = pipeline_apply(
-            self.mesh, _stage_fn_factory(cfg), params["stages"],
-            x, remat=self._remat)
-        from dlrover_tpu.ops.norms import reference_rms_norm
-
-        out = reference_rms_norm(out, params["final_norm"]
-                                 .astype(jnp.float32), cfg.rms_norm_eps)
-        logits = jnp.dot(out.astype(cfg.dtype),
-                         params["lm_head"].astype(cfg.dtype))
-        return logits.astype(jnp.float32)
+    def _loss(self, params, tokens, targets):
+        spec = self.spec
+        return pipeline_train(
+            self.mesh, spec.chunk_fn, params["chunks"], params["shared"],
+            spec.enter_fn, spec.exit_fn, tokens, targets,
+            num_rounds=self.num_rounds, remat=self._remat)
 
     def step(self, state: TrainState, tokens, targets):
         if self._step is None:
-            loss_fn = self._loss_fn
             tx = self._tx
 
             def train_step(state, tokens, targets):
-                def compute(params):
-                    logits = self._forward(params, tokens)
-                    return loss_fn(
-                        logits.reshape(-1, *logits.shape[2:]),
-                        targets.reshape(-1, *targets.shape[2:]))
-
-                loss, grads = jax.value_and_grad(compute)(state.params)
+                loss, grads = jax.value_and_grad(self._loss)(
+                    state.params, tokens, targets)
                 updates, opt_state = tx.update(grads, state.opt_state,
                                                state.params)
                 params = optax.apply_updates(state.params, updates)
@@ -221,13 +384,32 @@ class PipelinedLlamaTrainer:
         return self._step(state, tokens, targets)
 
 
-def build_pipeline_trainer(cfg: LlamaConfig,
+def build_pipeline_trainer(cfg: Union[LlamaConfig, GPTConfig],
                            tx: optax.GradientTransformation,
                            mesh: Mesh, num_microbatches: int,
                            micro_batch: int, seq_len: int, loss_fn,
+                           num_rounds: int = 1,
                            remat: bool = False,
                            rules: Optional[Sequence] = None
-                           ) -> PipelinedLlamaTrainer:
-    return PipelinedLlamaTrainer(cfg, tx, mesh, num_microbatches,
-                                 micro_batch, seq_len, loss_fn,
-                                 remat=remat, rules=rules)
+                           ) -> PipelinedTrainer:
+    """Lower a stacked-block model config to a pipelined trainer.
+
+    Any model family with a PipelineModelSpec pipelines; LlamaConfig and
+    GPTConfig ship built in (the reference pipelines arbitrary
+    fx-traceable models via PiPPy — spec construction is the analog).
+
+    loss_fn contract: a BATCH-MEAN loss (logits, targets) -> scalar, the
+    mean over its batch rows (cross_entropy_loss qualifies). The pipeline
+    applies it per microbatch row and averages — a sum-reducing loss
+    would silently change scale vs the dense trainer."""
+    if isinstance(cfg, LlamaConfig):
+        spec = llama_pipeline_spec(cfg, seq_len, loss_fn)
+    elif isinstance(cfg, GPTConfig):
+        spec = gpt_pipeline_spec(cfg, seq_len, loss_fn)
+    else:
+        raise NotImplementedError(
+            f"no pipeline spec for {type(cfg).__name__}; provide a "
+            "PipelineModelSpec and construct PipelinedTrainer directly")
+    return PipelinedTrainer(spec, tx, mesh, num_microbatches,
+                            micro_batch, seq_len, num_rounds=num_rounds,
+                            remat=remat, rules=rules)
