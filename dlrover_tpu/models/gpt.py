@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from dlrover_tpu.models.llama import embed_lookup
 from dlrover_tpu.ops.flash_attention import (
-    flash_attention,
+    mesh_flash_attention,
     reference_attention,
 )
 
@@ -75,7 +75,7 @@ class Block(nn.Module):
             for t in (q, k, v)
         )
         if cfg.attn_impl == "flash":
-            attn = flash_attention(q, k, v, True)
+            attn = mesh_flash_attention(q, k, v, True)
         else:
             attn = reference_attention(q, k, v, True)
         attn = attn.transpose(0, 2, 1, 3).reshape(batch, seq, cfg.n_embd)

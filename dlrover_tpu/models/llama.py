@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from dlrover_tpu.ops.flash_attention import (
-    flash_attention,
+    mesh_flash_attention,
     reference_attention,
 )
 from dlrover_tpu.ops.norms import fused_rms_norm, reference_rms_norm
@@ -226,7 +226,7 @@ class Attention(nn.Module):
             # (b, heads, seq, dim) layout for the kernel
             q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             if impl == "flash":
-                out = flash_attention(q, k, v, True)
+                out = mesh_flash_attention(q, k, v, True)
             else:
                 out = reference_attention(q, k, v, True)
             out = out.transpose(0, 2, 1, 3).reshape(batch, seq, -1)

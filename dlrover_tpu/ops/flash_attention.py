@@ -462,3 +462,50 @@ def reference_attention(q, k, v, causal: bool = True,
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
+
+
+def mesh_flash_attention(q, k, v, causal: bool = True,
+                         sm_scale: Optional[float] = None) -> jax.Array:
+    """flash_attention partitioned over the ambient mesh.
+
+    A Pallas kernel is a custom call the SPMD partitioner cannot split on
+    real TPU, so under a multi-device mesh it must run inside a shard_map
+    that makes the batch/head axes manual: batch over (data, fsdp), heads
+    over tensor — each device runs the kernel on its local block. Falls
+    back to the plain call when there is no ambient mesh (single chip),
+    when no relevant axis is >1, or when the shapes don't divide (XLA
+    then reports the partitioning failure loudly rather than silently
+    replicating)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.common.constants import MeshAxis
+    from dlrover_tpu.parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return flash_attention(q, k, v, causal, sm_scale)
+    # Inside an already-manual region (e.g. the pipeline's pipe-manual
+    # shard_map) a nested full-mesh shard_map cannot be traced (mesh
+    # mismatch / interpret-mode carry typing) — call the kernel directly;
+    # its operands there are the caller's per-shard blocks.
+    if _vma(q, k, v):
+        return flash_attention(q, k, v, causal, sm_scale)
+    # foreign ambient meshes (no data/fsdp/tensor axes) fall through to
+    # the plain call via the dp == tp == 1 check
+    dp = (mesh.shape.get(MeshAxis.DATA, 1)
+          * mesh.shape.get(MeshAxis.FSDP, 1))
+    tp = mesh.shape.get(MeshAxis.TENSOR, 1)
+    if dp == 1 and tp == 1:
+        return flash_attention(q, k, v, causal, sm_scale)
+    if (q.shape[0] % dp or q.shape[1] % tp or k.shape[1] % tp):
+        return flash_attention(q, k, v, causal, sm_scale)
+    spec = P((MeshAxis.DATA, MeshAxis.FSDP), MeshAxis.TENSOR, None, None)
+    fn = shard_map(
+        lambda a, b, c: flash_attention(a, b, c, causal, sm_scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -200,6 +200,16 @@ class TestPipelinedTrainer:
         tokens = np.zeros((32, 16), np.int32)
         trainer.shard_batch(tokens, tokens)
 
+    def test_pipeline_with_flash_attn_traces(self, cpu_devices):
+        """attn_impl='flash' inside the pipe-manual shard_map: the
+        mesh_flash_attention wrapper must step aside (its nested
+        shard_map cannot trace there) and the kernel must run on the
+        per-stage blocks."""
+        cfg = LlamaConfig.tiny(attn_impl="flash", dtype=jnp.float32)
+        mesh = create_mesh(MeshSpec(data=2, pipe=2), cpu_devices[:4])
+        _, _, losses = _run(cfg, mesh, steps=1)
+        assert np.isfinite(losses).all()
+
     def test_clean_spmd_lowering_pipeline(self, cpu_devices, capfd):
         """The pipeline lowering on a (data, fsdp, pipe) mesh must not hit
         XLA's 'Involuntary full rematerialization' fallback (the dense
