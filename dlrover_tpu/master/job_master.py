@@ -237,7 +237,13 @@ class JobMaster:
 
 def run_master_main(args=None) -> int:
     """CLI entry: `python -m dlrover_tpu.master.job_master --port ...`
-    (reference: master/main.py:55)."""
+    (reference: master/main.py:55, platform dispatch main.py:37-52).
+
+    On `--platform k8s` the master fetches its own ElasticJob CR (the
+    operator only passes the job name — reference: the Go master pod gets
+    the job name and reads the CRD) and runs the full node-lifecycle
+    composition with the pod scaler/watcher; otherwise it is the
+    standalone/local rendezvous master."""
     import argparse
 
     parser = argparse.ArgumentParser("dlrover-tpu master")
@@ -245,9 +251,42 @@ def run_master_main(args=None) -> int:
     parser.add_argument("--min-nodes", type=int, default=1)
     parser.add_argument("--max-nodes", type=int, default=1)
     parser.add_argument("--node-unit", type=int, default=1)
+    parser.add_argument("--platform", default="local",
+                        choices=["local", "k8s"])
+    parser.add_argument("--job-name", default="")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--brain-addr", default="")
     ns = parser.parse_args(args)
-    master = JobMaster(port=ns.port, min_nodes=ns.min_nodes,
-                       max_nodes=ns.max_nodes, node_unit=ns.node_unit)
+    if ns.platform == "k8s":
+        from dlrover_tpu.operator.crd import (
+            ELASTICJOB_PLURAL,
+            GROUP,
+            VERSION,
+            ElasticJob,
+        )
+        from dlrover_tpu.scheduler.kubernetes import K8sClient
+
+        client = K8sClient(namespace=ns.namespace)
+        manifest = client.api.request(
+            "GET",
+            f"/apis/{GROUP}/{VERSION}/namespaces/{ns.namespace}"
+            f"/{ELASTICJOB_PLURAL}/{ns.job_name}")
+        job = ElasticJob.from_manifest(manifest)
+        job_args = job.to_job_args()
+        worker = job_args.worker_args()
+        if worker is not None:
+            count = worker.group_resource.count
+            min_nodes = max(1, worker.min_count or count)
+            max_nodes = max(min_nodes, worker.max_count or count)
+        else:
+            min_nodes = max_nodes = 1
+        master = JobMaster(port=ns.port, min_nodes=min_nodes,
+                           max_nodes=max_nodes, node_unit=ns.node_unit,
+                           job_args=job_args, cluster=client,
+                           brain_addr=ns.brain_addr)
+    else:
+        master = JobMaster(port=ns.port, min_nodes=ns.min_nodes,
+                           max_nodes=ns.max_nodes, node_unit=ns.node_unit)
     master.prepare()
     print(f"DLROVER_TPU_MASTER_ADDR={master.addr}", flush=True)
     return master.run()

@@ -114,6 +114,11 @@ class ElasticJobController:
                          action.arg)
 
     def _create_master(self) -> None:
+        if hasattr(self._cluster, "create_master"):
+            # k8s backend: master runs as a pod behind a stable service
+            # (reference: master/master.go:53-162)
+            self.master_addr = self._cluster.create_master()
+            return
         from dlrover_tpu.scheduler.local import PodRecord
 
         if self._master_factory is not None:

@@ -1,0 +1,178 @@
+"""In-process fake Kubernetes API server for operator e2e tests.
+
+Mirrors the reference's test strategy (mock_k8s_client,
+dlrover/python/tests/test_utils.py:238-253) but at the HTTP layer, so the
+zero-dependency REST client and the operator's watch streams are exercised
+for real.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List
+from urllib.parse import parse_qs, urlparse
+
+
+class FakeK8s:
+    """State + server. Pods/CRs are plain manifest dicts keyed by name."""
+
+    def __init__(self):
+        self.pods: Dict[str, Dict[str, Any]] = {}
+        self.services: Dict[str, Dict[str, Any]] = {}
+        self.elasticjobs: Dict[str, Dict[str, Any]] = {}
+        self.scaleplans: Dict[str, Dict[str, Any]] = {}
+        self.patches: List[Dict[str, Any]] = []   # (path, body) log
+        self._watchers: Dict[str, List[queue.Queue]] = {}
+        self._lock = threading.Lock()
+        state = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # noqa: D102 — silence
+                pass
+
+            def _send_json(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                params = parse_qs(url.query)
+                if params.get("watch") == ["true"]:
+                    return self._watch(url.path)
+                match = re.match(r"^/api/v1/namespaces/[^/]+/pods$",
+                                 url.path)
+                if match:
+                    selector = params.get("labelSelector", [""])[0]
+                    items = state.list_pods(selector)
+                    return self._send_json({"items": items})
+                match = re.match(
+                    r"^/apis/[^/]+/[^/]+/namespaces/[^/]+/(\w+)$", url.path)
+                if match:
+                    store = getattr(state, match.group(1), {})
+                    return self._send_json(
+                        {"items": list(store.values())})
+                match = re.match(
+                    r"^/apis/[^/]+/[^/]+/namespaces/[^/]+/(\w+)/([^/]+)$",
+                    url.path)
+                if match:
+                    store = getattr(state, match.group(1), None)
+                    obj = (store or {}).get(match.group(2))
+                    if obj is not None:
+                        return self._send_json(obj)
+                self._send_json({}, 404)
+
+            def _watch(self, path):
+                match = re.match(
+                    r"^/apis/[^/]+/[^/]+/namespaces/[^/]+/(\w+)$", path)
+                kind = match.group(1) if match else path
+                q: queue.Queue = queue.Queue()
+                with state._lock:
+                    state._watchers.setdefault(kind, []).append(q)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                try:
+                    while True:
+                        event = q.get()
+                        if event is None:
+                            break
+                        self.wfile.write(
+                            (json.dumps(event) + "\n").encode())
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    with state._lock:
+                        if q in state._watchers.get(kind, []):
+                            state._watchers[kind].remove(q)
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                body = self._body()
+                name = body.get("metadata", {}).get("name", "")
+                if url.path.endswith("/pods"):
+                    body.setdefault("status", {})["phase"] = "Pending"
+                    state.pods[name] = body
+                    return self._send_json(body, 201)
+                if url.path.endswith("/services"):
+                    state.services[name] = body
+                    return self._send_json(body, 201)
+                self._send_json({}, 404)
+
+            def do_DELETE(self):
+                url = urlparse(self.path)
+                name = url.path.rsplit("/", 1)[-1]
+                if "/pods/" in url.path and name in state.pods:
+                    del state.pods[name]
+                    return self._send_json({})
+                self._send_json({}, 404)
+
+            def do_PATCH(self):
+                body = self._body()
+                state.patches.append({"path": self.path, "body": body})
+                match = re.match(
+                    r"^/apis/[^/]+/[^/]+/namespaces/[^/]+/(\w+)/([^/]+)"
+                    r"(/status)?$", self.path)
+                if match:
+                    store = getattr(state, match.group(1), None)
+                    obj = (store or {}).get(match.group(2))
+                    if obj is not None:
+                        for key, value in body.items():
+                            if isinstance(value, dict):
+                                obj.setdefault(key, {}).update(value)
+                            else:
+                                obj[key] = value
+                return self._send_json(body)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> str:
+        self._thread.start()
+        return f"http://127.0.0.1:{self._server.server_port}"
+
+    def stop(self) -> None:
+        with self._lock:
+            for queues in self._watchers.values():
+                for q in queues:
+                    q.put(None)
+        self._server.shutdown()
+
+    # -- test drivers ---------------------------------------------------
+    def list_pods(self, selector: str) -> List[Dict[str, Any]]:
+        wanted = dict(part.split("=", 1)
+                      for part in selector.split(",") if "=" in part)
+        out = []
+        for pod in self.pods.values():
+            labels = pod.get("metadata", {}).get("labels", {})
+            if all(labels.get(k) == v for k, v in wanted.items()):
+                out.append(pod)
+        return out
+
+    def set_pod_phase(self, name: str, phase: str) -> None:
+        self.pods[name].setdefault("status", {})["phase"] = phase
+
+    def push_event(self, kind: str, event_type: str,
+                   obj: Dict[str, Any]) -> None:
+        """Deliver a watch event to every open {kind} watch."""
+        with self._lock:
+            for q in self._watchers.get(kind, []):
+                q.put({"type": event_type, "object": obj})
+
+    def watcher_count(self, kind: str) -> int:
+        with self._lock:
+            return len(self._watchers.get(kind, []))

@@ -1,0 +1,355 @@
+"""K8s operator e2e against a fake API server: CRD parsing, master
+pod/service creation with the env contract, job phase status sync, watch
+streams, and the ScalePlan relay to a live master.
+
+Reference parity targets: elasticjob_controller.go:85 (Reconcile),
+master/master.go:53-188 (master pod/service + DLROVER_MASTER_ADDR),
+scaleplan_controller relay, elasticjob_types.go:29-123 /
+scaleplan_types.go:29-121 (API shapes).
+"""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.operator.crd import (
+    ElasticJob,
+    ElasticJobSpec,
+    ReplicaSpec,
+    ScalePlan,
+)
+from dlrover_tpu.operator.k8s_operator import (
+    K8sElasticJobOperator,
+    K8sJobCluster,
+    MASTER_PORT,
+)
+from dlrover_tpu.scheduler.kubernetes import K8sApi, K8sClient
+from tests.fake_k8s import FakeK8s
+
+
+def wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+SAMPLE_JOB = {
+    "apiVersion": "elastic.dlrover-tpu.org/v1alpha1",
+    "kind": "ElasticJob",
+    "metadata": {"name": "demo", "namespace": "default", "uid": "u-123"},
+    "spec": {
+        "distributionStrategy": "AllreduceStrategy",
+        "optimizeMode": "single-job",
+        "enableDynamicSharding": True,
+        "replicaSpecs": {
+            "worker": {
+                "replicas": 4,
+                "minReplicas": 2,
+                "maxReplicas": 8,
+                "restartCount": 3,
+                "template": {"spec": {
+                    "containers": [{
+                        "name": "main",
+                        "image": "img:latest",
+                        "command": ["/bin/sh", "-c",
+                                    "dlrover-tpu-run train.py"],
+                        "resources": {"limits": {
+                            "cpu": "8", "memory": "32Gi",
+                            "google.com/tpu": "4"}},
+                    }],
+                    "nodeSelector": {
+                        "cloud.google.com/gke-tpu-accelerator":
+                            "tpu-v5p-slice",
+                        "cloud.google.com/gke-tpu-topology": "2x2x1",
+                    },
+                }},
+            },
+        },
+    },
+}
+
+
+class TestCrdSchemas:
+    def test_elasticjob_roundtrip(self):
+        job = ElasticJob.from_manifest(SAMPLE_JOB)
+        assert job.name == "demo" and job.uid == "u-123"
+        spec = job.spec.replica_specs["worker"]
+        assert (spec.replicas, spec.min_replicas, spec.max_replicas) == (
+            4, 2, 8)
+        assert spec.image == "img:latest"
+        assert spec.command == "dlrover-tpu-run train.py"
+        assert spec.resource.chips == 4
+        assert spec.resource.memory_mb == 32 * 1024
+        assert spec.resource.chip_type == "tpu-v5p-slice"
+        assert spec.tpu_topology == "2x2x1"
+        # round-trip: parse(serialize(x)) == x
+        again = ElasticJob.from_manifest(job.to_manifest())
+        assert again.spec == job.spec
+        owner = job.owner_reference()
+        assert owner["uid"] == "u-123" and owner["controller"]
+
+    def test_k8s_quantity_parsing(self):
+        """Standard k8s quantity formats must not wedge the operator."""
+        from dlrover_tpu.operator.crd import parse_cpu, parse_memory_mb
+
+        assert parse_cpu("500m") == 0.5
+        assert parse_cpu("8") == 8.0
+        assert parse_cpu("") == 0.0
+        assert parse_memory_mb("32Gi") == 32 * 1024
+        assert parse_memory_mb("512Mi") == 512
+        assert abs(parse_memory_mb("1G") - 1e9 / (1 << 20)) < 1e-6
+        assert parse_memory_mb("1048576") == 1.0   # plain bytes
+        job = ElasticJob.from_manifest({
+            "metadata": {"name": "q"},
+            "spec": {"replicaSpecs": {"worker": {
+                "replicas": 1,
+                "template": {"spec": {"containers": [{
+                    "resources": {"limits": {"cpu": "500m",
+                                             "memory": "1G"}}}]}},
+            }}},
+        })
+        assert job.spec.replica_specs["worker"].resource.cpu == 0.5
+
+    def test_to_job_args_conveys_replica_specs(self):
+        """The k8s-launched master learns the job's replica specs from
+        the CR (run_master_main --platform k8s path)."""
+        job = ElasticJob.from_manifest(SAMPLE_JOB)
+        args = job.to_job_args()
+        worker = args.worker_args()
+        assert worker.group_resource.count == 4
+        assert (worker.min_count, worker.max_count) == (2, 8)
+        assert worker.group_resource.node_resource.chips == 4
+        assert args.image == "img:latest"
+        assert args.command == "dlrover-tpu-run train.py"
+        assert args.platform == "k8s"
+
+    def test_scaleplan_parsing(self):
+        plan = ScalePlan.from_manifest({
+            "metadata": {"name": "up"},
+            "spec": {
+                "ownerJob": "demo",
+                "manualScaling": True,
+                "replicaResourceSpecs": {"worker": {"replicas": 6}},
+                "removePods": [{"name": "demo-worker-3"}],
+            },
+        })
+        assert plan.spec.owner_job == "demo"
+        assert plan.spec.replica_resource_specs == {"worker": 6}
+        assert plan.spec.remove_pods == ["demo-worker-3"]
+
+    def test_sample_manifests_parse(self):
+        """The shipped sample YAMLs must parse into valid CRD objects."""
+        yaml = pytest.importorskip("yaml")
+        with open("manifests/samples/elasticjob_llama.yaml") as f:
+            job = ElasticJob.from_manifest(yaml.safe_load(f))
+        assert job.spec.replica_specs["worker"].replicas == 4
+        with open("manifests/samples/scaleplan_sample.yaml") as f:
+            plan = ScalePlan.from_manifest(yaml.safe_load(f))
+        assert plan.spec.replica_resource_specs == {"worker": 6}
+
+
+@pytest.fixture()
+def fake_k8s():
+    fake = FakeK8s()
+    host = fake.start()
+    client = K8sClient("default", api=K8sApi(host=host, token="test"))
+    yield fake, client
+    fake.stop()
+
+
+class TestK8sOperatorE2E:
+    def test_job_lifecycle_and_scale_relay(self, fake_k8s):
+        fake, client = fake_k8s
+        fake.elasticjobs["demo"] = SAMPLE_JOB
+
+        operator = K8sElasticJobOperator(client=client,
+                                         reconcile_interval_s=0.1)
+        operator.start()
+        try:
+            # Adopted the pre-existing CR and created master pod + service
+            # with the env contract and owner ref.
+            assert wait_until(lambda: "demo-master-0" in fake.pods)
+            master_pod = fake.pods["demo-master-0"]
+            env = {e["name"]: e["value"] for e in
+                   master_pod["spec"]["containers"][0]["env"]}
+            assert env["DLROVER_TPU_MASTER_ADDR"] == (
+                f"demo-dlrover-master.default:{MASTER_PORT}")
+            assert (master_pod["metadata"]["ownerReferences"][0]["uid"]
+                    == "u-123")
+            assert "demo-dlrover-master" in fake.services
+            # status patched to Pending while the master pod is pending
+            assert wait_until(lambda: any(
+                "elasticjobs/demo/status" in p["path"] for p in
+                fake.patches))
+
+            # master goes Running -> job phase Running
+            fake.set_pod_phase("demo-master-0", "Running")
+            assert wait_until(lambda: any(
+                p["body"].get("status", {}).get("phase") == "Running"
+                and "elasticjobs/demo" in p["path"]
+                for p in fake.patches))
+
+            # Point the controller at a live in-process master and push a
+            # ScalePlan through the watch stream: the operator must relay
+            # it over gRPC and patch the plan status.
+            from dlrover_tpu.master.job_master import JobMaster
+            from dlrover_tpu.scheduler.local import LocalCluster
+            from tests.test_job_manager import make_job_args
+
+            cluster = LocalCluster()
+            master = JobMaster(min_nodes=2, max_nodes=8,
+                               job_args=make_job_args(workers=2),
+                               cluster=cluster, host="127.0.0.1")
+            master.prepare()
+            try:
+                assert wait_until(lambda: len(
+                    master.job_manager.get_running_workers()) == 2)
+                operator._controllers["demo"].master_addr = master.addr
+                assert wait_until(
+                    lambda: fake.watcher_count("scaleplans") > 0)
+                fake.push_event("scaleplans", "ADDED", {
+                    "metadata": {"name": "up"},
+                    "spec": {"ownerJob": "demo",
+                             "replicaResourceSpecs":
+                                 {NodeType.WORKER: {"replicas": 3}}},
+                })
+                assert wait_until(lambda: len(
+                    master.job_manager.get_running_workers()) == 3)
+                assert wait_until(lambda: any(
+                    "scaleplans/up/status" in p["path"]
+                    and p["body"]["status"]["phase"] == "Relayed"
+                    for p in fake.patches))
+            finally:
+                master.stop()
+
+            # Deleting the CR drops the controller.
+            assert wait_until(
+                lambda: fake.watcher_count("elasticjobs") > 0)
+            fake.push_event("elasticjobs", "DELETED", SAMPLE_JOB)
+            assert wait_until(
+                lambda: "demo" not in operator._controllers)
+        finally:
+            operator.stop()
+
+    def test_new_job_via_watch_and_master_relaunch(self, fake_k8s):
+        fake, client = fake_k8s
+        operator = K8sElasticJobOperator(client=client,
+                                         reconcile_interval_s=0.1)
+        operator.start()
+        try:
+            assert wait_until(
+                lambda: fake.watcher_count("elasticjobs") > 0)
+            fake.push_event("elasticjobs", "ADDED", SAMPLE_JOB)
+            assert wait_until(lambda: "demo-master-0" in fake.pods)
+            # master pod fails -> relaunched (budget 3)
+            fake.set_pod_phase("demo-master-0", "Failed")
+            assert wait_until(
+                lambda: operator._controllers["demo"].master_restarts == 1)
+            assert wait_until(lambda: fake.pods.get(
+                "demo-master-0", {}).get("status", {}).get("phase")
+                == "Pending")
+        finally:
+            operator.stop()
+
+    def test_scaleplan_idempotency_and_orphan_parking(self, fake_k8s):
+        """A plan is relayed ONCE (status-echo MODIFIED events and watch
+        replays are skipped), and a plan arriving before its owner job is
+        parked and relayed when the job appears."""
+        fake, client = fake_k8s
+        operator = K8sElasticJobOperator(client=client,
+                                         reconcile_interval_s=0.05)
+
+        def plan_patches():
+            return [p for p in fake.patches
+                    if "scaleplans/early/status" in p["path"]]
+
+        operator.start()
+        try:
+            assert wait_until(
+                lambda: fake.watcher_count("scaleplans") > 0)
+            plan_obj = {
+                "metadata": {"name": "early"},
+                "spec": {"ownerJob": "demo",
+                         "replicaResourceSpecs":
+                             {"worker": {"replicas": 5}}},
+            }
+            # Plan arrives BEFORE its job: parked, not lost.
+            fake.push_event("scaleplans", "ADDED", plan_obj)
+            assert wait_until(
+                lambda: "early" in operator._orphan_plans)
+            # Job appears; the parked plan is relayed on the next tick.
+            fake.push_event("elasticjobs", "ADDED", SAMPLE_JOB)
+            assert wait_until(lambda: "demo" in operator._controllers)
+            assert wait_until(lambda: len(plan_patches()) == 1)
+            controller = operator._controllers["demo"]
+            assert controller.pending_scale_plan.count == 5
+            # Replays and the status-echo MODIFIED are skipped: no second
+            # relay, no second status patch.
+            fake.push_event("scaleplans", "ADDED", plan_obj)
+            relayed = dict(plan_obj, status={"phase": "Relayed"})
+            fake.push_event("scaleplans", "MODIFIED", relayed)
+            time.sleep(0.3)
+            assert len(plan_patches()) == 1
+        finally:
+            operator.stop()
+
+    def test_k8s_master_reads_cr_and_creates_workers(self, fake_k8s,
+                                                     monkeypatch):
+        """The master pod's entry (`--platform k8s --job-name demo`) must
+        fetch the ElasticJob CR, build JobArgs from replicaSpecs, and
+        create the worker pods through the pod scaler — the full
+        operator -> master -> workers chain on the fake API server."""
+        fake, client = fake_k8s
+        fake.elasticjobs["demo"] = SAMPLE_JOB
+        import dlrover_tpu.scheduler.kubernetes as k8s_mod
+
+        monkeypatch.setattr(k8s_mod, "K8sClient",
+                            lambda namespace="default": client)
+        from dlrover_tpu.master import job_master as jm
+
+        started = {}
+        original_prepare = jm.JobMaster.prepare
+
+        def prepare_and_stop(self):
+            original_prepare(self)
+            started["master"] = self
+
+        monkeypatch.setattr(jm.JobMaster, "prepare", prepare_and_stop)
+        monkeypatch.setattr(
+            jm.JobMaster, "run", lambda self, *a, **k: 0)
+        assert jm.run_master_main([
+            "--platform", "k8s", "--job-name", "demo",
+            "--namespace", "default"]) == 0
+        master = started["master"]
+        try:
+            assert master.job_manager is not None
+            # replicaSpecs conveyed: 4 workers requested on the fake API
+            assert wait_until(lambda: sum(
+                1 for name in fake.pods if "worker" in name) == 4)
+            worker = fake.pods["demo-worker-0"]
+            env = {e["name"]: e["value"] for e in
+                   worker["spec"]["containers"][0]["env"]}
+            assert env["DLROVER_TPU_MASTER_ADDR"]
+            limits = worker["spec"]["containers"][0]["resources"]["limits"]
+            assert limits["google.com/tpu"] == "4"
+        finally:
+            master.stop()
+
+    def test_suspended_job_creates_nothing(self, fake_k8s):
+        fake, client = fake_k8s
+        suspended = dict(SAMPLE_JOB,
+                         spec=dict(SAMPLE_JOB["spec"], suspend=True))
+        fake.elasticjobs["demo"] = suspended
+        operator = K8sElasticJobOperator(client=client,
+                                         reconcile_interval_s=0.05)
+        operator.start()
+        try:
+            time.sleep(0.5)
+            assert "demo-master-0" not in fake.pods
+        finally:
+            operator.stop()
