@@ -62,7 +62,9 @@ class ElasticJobController:
         self.master_restarts = 0
         self.max_master_restarts = max_master_restarts
         self.master_addr = ""
-        self.pending_scale_plan: Optional[msg.ScaleRequest] = None
+        # node type -> requested count; a ScalePlan may scale several
+        # node groups at once (scaleplan_types.go replicaResourceSpecs)
+        self.pending_scale_plans: Dict[str, int] = {}
         self.suspended = False
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -81,7 +83,7 @@ class ElasticJobController:
             master_restarts=self.master_restarts,
             max_master_restarts=self.max_master_restarts,
             suspended=self.suspended,
-            pending_scale_plan=self.pending_scale_plan is not None,
+            pending_scale_plan=bool(self.pending_scale_plans),
             workers_total=len(workers),
             workers_running=sum(
                 1 for p in workers if p.status == NodeStatus.RUNNING),
@@ -116,8 +118,11 @@ class ElasticJobController:
     def _create_master(self) -> None:
         if hasattr(self._cluster, "create_master"):
             # k8s backend: master runs as a pod behind a stable service
-            # (reference: master/master.go:53-162)
-            self.master_addr = self._cluster.create_master()
+            # (reference: master/master.go:53-162). The pod name carries
+            # the restart ordinal: a relaunch must not collide with the
+            # old pod's asynchronous (graceful) deletion.
+            self.master_addr = self._cluster.create_master(
+                ordinal=self.master_restarts)
             return
         from dlrover_tpu.scheduler.local import PodRecord
 
@@ -132,26 +137,31 @@ class ElasticJobController:
         ))
 
     def _relay_scale_plan(self) -> None:
-        plan = self.pending_scale_plan
-        self.pending_scale_plan = None
-        if plan is None or not self.master_addr:
+        plans, self.pending_scale_plans = self.pending_scale_plans, {}
+        if not plans or not self.master_addr:
             return
         from dlrover_tpu.agent.master_client import MasterClient
 
         try:
             client = MasterClient(self.master_addr, node_id=-1)
-            client._report(plan)
-            client.close()
-            logger.info("relayed scale plan %s=%d to master",
-                        plan.node_type, plan.count)
+            try:
+                for node_type, count in list(plans.items()):
+                    client._report(msg.ScaleRequest(node_type=node_type,
+                                                    count=count))
+                    logger.info("relayed scale plan %s=%d to master",
+                                node_type, count)
+                    del plans[node_type]
+            finally:
+                client.close()
         except Exception as e:  # noqa: BLE001
             logger.warning("scale-plan relay failed: %s; requeued", e)
-            self.pending_scale_plan = plan
+            # not-yet-sent entries go back; a newer request wins
+            for node_type, count in plans.items():
+                self.pending_scale_plans.setdefault(node_type, count)
 
     def submit_scale_plan(self, node_type: str, count: int) -> None:
         """The ScalePlan-CR entry (reference: ScalePlanReconciler)."""
-        self.pending_scale_plan = msg.ScaleRequest(node_type=node_type,
-                                                   count=count)
+        self.pending_scale_plans[node_type] = count
 
     # -- loop ------------------------------------------------------------
     def reconcile_once(self) -> JobObserved:

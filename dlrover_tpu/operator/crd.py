@@ -98,19 +98,15 @@ class ReplicaSpec:
         )
 
     def to_manifest(self) -> Dict[str, Any]:
-        limits: Dict[str, Any] = {}
-        if self.resource.cpu:
-            limits["cpu"] = str(self.resource.cpu)
-        if self.resource.memory_mb:
-            limits["memory"] = f"{int(self.resource.memory_mb)}Mi"
-        if self.resource.chips:
-            limits["google.com/tpu"] = str(self.resource.chips)
-        selector: Dict[str, str] = {}
-        if self.resource.chip_type:
-            selector["cloud.google.com/gke-tpu-accelerator"] = (
-                self.resource.chip_type)
-        if self.tpu_topology:
-            selector["cloud.google.com/gke-tpu-topology"] = self.tpu_topology
+        from dlrover_tpu.scheduler.kubernetes import (
+            resource_to_limits,
+            shell_command,
+            tpu_node_selector,
+        )
+
+        limits = resource_to_limits(self.resource)
+        selector = tpu_node_selector(self.resource.chip_type,
+                                     self.tpu_topology)
         spec: Dict[str, Any] = {
             "replicas": self.replicas,
             "restartCount": self.restart_count,
@@ -118,8 +114,7 @@ class ReplicaSpec:
                 "containers": [{
                     "name": "main",
                     "image": self.image,
-                    "command": (["/bin/sh", "-c", self.command]
-                                if self.command else None),
+                    "command": shell_command(self.command),
                     "resources": {"limits": limits},
                 }],
                 "nodeSelector": selector or None,
@@ -282,8 +277,12 @@ class ScaleSpec:
     def from_manifest(cls, spec: Dict[str, Any]) -> "ScaleSpec":
         replica_specs = {}
         for name, rs in (spec.get("replicaResourceSpecs", {}) or {}).items():
-            replica_specs[name] = int(
-                rs.get("replicas", rs) if isinstance(rs, dict) else rs)
+            if isinstance(rs, dict):
+                if "replicas" not in rs:
+                    continue    # resource-only entry: nothing to scale
+                replica_specs[name] = int(rs["replicas"])
+            else:
+                replica_specs[name] = int(rs)
         return cls(
             owner_job=spec.get("ownerJob", ""),
             replica_resource_specs=replica_specs,

@@ -43,6 +43,7 @@ class _PodView:
         self.name = fields["name"]
         self.node_type = fields["node_type"]
         self.status = fields["status"]
+        self.terminating = fields.get("terminating", False)
 
 
 class K8sJobCluster:
@@ -63,8 +64,12 @@ class K8sJobCluster:
         selector = f"dlrover-tpu/job={self.job.name}"
         if node_type:
             selector += f",dlrover-tpu/type={node_type}"
-        return [_PodView(pod_to_fields(p))
-                for p in self._client.list_pods(selector)]
+        views = [_PodView(pod_to_fields(p))
+                 for p in self._client.list_pods(selector)]
+        # A pod under graceful deletion must read as gone, or the
+        # reconciler re-fires RELAUNCH_MASTER every tick while the old
+        # pod lingers Terminating and burns the restart budget.
+        return [v for v in views if not v.terminating]
 
     def delete_pod(self, name: str) -> bool:
         return self._client.delete_pod(name)
@@ -77,15 +82,17 @@ class K8sJobCluster:
         return (f"{self.job.name}-dlrover-master."
                 f"{self.job.namespace}:{MASTER_PORT}")
 
-    def create_master(self) -> str:
-        """Create the master pod + stable service; returns the address."""
+    def create_master(self, ordinal: int = 0) -> str:
+        """Create the master pod + stable service; returns the address.
+        `ordinal` is the restart count — each relaunch gets a fresh pod
+        name so it cannot 409 against the old pod's graceful deletion."""
         spec = self.job.spec.replica_specs.get(
             "master", self.job.spec.replica_specs.get(NodeType.WORKER))
         image = spec.image if spec else ""
         manifest = build_pod_manifest(
             job_name=self.job.name,
             node_type=NodeType.MASTER,
-            node_id=0,
+            node_id=ordinal,
             rank_index=0,
             image=image,
             # The master reads its own ElasticJob CR to learn the replica
@@ -200,11 +207,14 @@ class K8sElasticJobOperator:
         echoes back as MODIFIED, and watch reconnects replay existing
         plans) are skipped; plans whose owner isn't tracked yet are
         parked and retried — the two watch streams race."""
-        if event.get("type") == "DELETED":
-            plan = ScalePlan.from_manifest(event.get("object", {}))
-            self._orphan_plans.pop(plan.name, None)
-            return
         plan = ScalePlan.from_manifest(event.get("object", {}))
+        if not plan.name:
+            return    # ERROR/Status watch events carry no object name
+        if event.get("type") == "DELETED":
+            self._orphan_plans.pop(plan.name, None)
+            # a later re-created plan with the same name is a NEW request
+            self._relayed_plans.discard(plan.name)
+            return
         if plan.phase == "Relayed" or plan.name in self._relayed_plans:
             return
         self._relay_plan(plan)

@@ -166,6 +166,34 @@ class K8sClient:
 # Pure manifest construction (unit-testable without a cluster).
 # ---------------------------------------------------------------------------
 
+def resource_to_limits(resource: NodeResource) -> Dict[str, str]:
+    """NodeResource → k8s resource limits (single source of truth, shared
+    with the CRD serialization in operator/crd.py)."""
+    limits: Dict[str, str] = {}
+    if resource.cpu:
+        limits["cpu"] = str(resource.cpu)
+    if resource.memory_mb:
+        limits["memory"] = f"{int(resource.memory_mb)}Mi"
+    if resource.chips:
+        limits["google.com/tpu"] = str(resource.chips)
+    return limits
+
+
+def tpu_node_selector(chip_type: str, tpu_topology: str = ""
+                      ) -> Dict[str, str]:
+    """GKE TPU placement labels (single source of truth)."""
+    selector: Dict[str, str] = {}
+    if chip_type:
+        selector["cloud.google.com/gke-tpu-accelerator"] = chip_type
+    if tpu_topology:
+        selector["cloud.google.com/gke-tpu-topology"] = tpu_topology
+    return selector
+
+
+def shell_command(command: str) -> Optional[List[str]]:
+    return ["/bin/sh", "-c", command] if command else None
+
+
 def build_pod_manifest(
     job_name: str,
     node_type: str,
@@ -194,19 +222,8 @@ def build_pod_manifest(
         {"name": NodeEnv.NODE_NUM, "value": str(node_num)},
         {"name": NodeEnv.JOB_NAME, "value": job_name},
     ]
-    limits: Dict[str, Any] = {}
-    if resource.cpu:
-        limits["cpu"] = str(resource.cpu)
-    if resource.memory_mb:
-        limits["memory"] = f"{int(resource.memory_mb)}Mi"
-    if resource.chips:
-        limits["google.com/tpu"] = str(resource.chips)
-    node_selector: Dict[str, str] = {}
-    if resource.chip_type:
-        node_selector["cloud.google.com/gke-tpu-accelerator"] = (
-            resource.chip_type)
-    if tpu_topology:
-        node_selector["cloud.google.com/gke-tpu-topology"] = tpu_topology
+    limits = resource_to_limits(resource)
+    node_selector = tpu_node_selector(resource.chip_type, tpu_topology)
     manifest: Dict[str, Any] = {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -224,7 +241,7 @@ def build_pod_manifest(
             "containers": [{
                 "name": "main",
                 "image": image,
-                "command": ["/bin/sh", "-c", command] if command else None,
+                "command": shell_command(command),
                 "env": env,
                 "resources": {"limits": limits, "requests": dict(limits)},
                 "ports": [{"containerPort": 8471}],  # TPU runtime port
@@ -274,4 +291,5 @@ def pod_to_fields(pod: Dict[str, Any]) -> Dict[str, Any]:
         "exit_reason": exit_reason,
         "host_ip": status.get("hostIP", ""),
         "pod_ip": status.get("podIP", ""),
+        "terminating": bool(meta.get("deletionTimestamp")),
     }

@@ -246,13 +246,18 @@ class TestK8sOperatorE2E:
                 lambda: fake.watcher_count("elasticjobs") > 0)
             fake.push_event("elasticjobs", "ADDED", SAMPLE_JOB)
             assert wait_until(lambda: "demo-master-0" in fake.pods)
-            # master pod fails -> relaunched (budget 3)
+            # master pod fails -> relaunched under a NEW name (no 409
+            # against the old pod's graceful deletion)
             fake.set_pod_phase("demo-master-0", "Failed")
             assert wait_until(
                 lambda: operator._controllers["demo"].master_restarts == 1)
-            assert wait_until(lambda: fake.pods.get(
-                "demo-master-0", {}).get("status", {}).get("phase")
-                == "Pending")
+            assert wait_until(lambda: "demo-master-1" in fake.pods)
+            # a pod under graceful deletion reads as gone
+            backend = operator._backends["demo"]
+            fake.pods["demo-master-1"]["metadata"]["deletionTimestamp"] = (
+                "2026-01-01T00:00:00Z")
+            names = [p.name for p in backend.list_pods("master")]
+            assert "demo-master-1" not in names
         finally:
             operator.stop()
 
@@ -287,7 +292,7 @@ class TestK8sOperatorE2E:
             assert wait_until(lambda: "demo" in operator._controllers)
             assert wait_until(lambda: len(plan_patches()) == 1)
             controller = operator._controllers["demo"]
-            assert controller.pending_scale_plan.count == 5
+            assert controller.pending_scale_plans == {"worker": 5}
             # Replays and the status-echo MODIFIED are skipped: no second
             # relay, no second status patch.
             fake.push_event("scaleplans", "ADDED", plan_obj)
