@@ -1,12 +1,20 @@
-"""Static model analysis feeding strategy pruning.
+"""Static model analysis + axis sizing feeding strategy planning.
 
-Capability parity: atorch Analyser (atorch/auto/analyser/analyser.py) —
-model size, dtypes, module inventory — done abstractly with
-`jax.eval_shape` so nothing is materialized.
+Capability parity: atorch Analyser (atorch/auto/analyser/analyser.py —
+model size, dtypes, module inventory) and the graph-sharding planners that
+SIZE parallel axes from the model and device topology
+(auto/opt_lib/shard_planners/mip_tp_planner.py:30). TPU re-design: all
+analysis is abstract (`jax.eval_shape`, nothing materialized) and the MIP
+over NVLink topology becomes closed-form sizing over the homogeneous
+device mesh — fsdp from HBM fit of the optimizer state, tensor from head
+divisibility and residual HBM pressure, remat from the activation
+footprint.
 """
 
 from __future__ import annotations
 
+import math
+import os
 from typing import Any, Dict
 
 import jax
@@ -14,6 +22,32 @@ import jax.numpy as jnp
 import numpy as np
 
 from dlrover_tpu.auto.model_context import ModelContext
+
+# Fraction of HBM the train state (params + optimizer) may claim; the rest
+# is activations, XLA scratch, and fragmentation headroom.
+STATE_HBM_FRACTION = 0.6
+# Rough fwd+bwd live-activation bytes per token per layer, in units of
+# hidden_size × activation bytes: residual stream + qkv + attention
+# internals + mlp intermediates (SwiGLU ≈ 2.7×hidden) saved for backward.
+ACTIVATION_FACTOR = 14.0
+
+
+def _model_dims(context: ModelContext) -> Dict[str, int]:
+    """Pull transformer dimensions from a dataclass model config when one
+    exists (LlamaConfig / GPTConfig / MoE variants)."""
+    cfg = context.model_config()
+    if cfg is None:
+        return {}
+    get = lambda *names: next(
+        (int(getattr(cfg, n)) for n in names if hasattr(cfg, n)), 0)
+    return {
+        "hidden_size": get("hidden_size", "n_embd"),
+        "num_layers": get("num_layers", "n_layer"),
+        "num_heads": get("num_heads", "n_head"),
+        "num_kv_heads": get("num_kv_heads", "num_heads", "n_head"),
+        "vocab_size": get("vocab_size"),
+        "intermediate_size": get("intermediate_size"),
+    }
 
 
 def analyse(context: ModelContext, micro_batch: int = 1) -> Dict[str, Any]:
@@ -33,17 +67,89 @@ def analyse(context: ModelContext, micro_batch: int = 1) -> Dict[str, Any]:
     # master copy ⇒ ~16 bytes/param upper bound.
     train_state_bytes = param_count * 16
     device = context.devices[0]
-    hbm_bytes = 0
-    stats = getattr(device, "memory_stats", lambda: None)()
-    if stats:
-        hbm_bytes = stats.get("bytes_limit", 0)
+    hbm_bytes = int(os.environ.get("DLROVER_TPU_HBM_BYTES", 0))
+    if not hbm_bytes:
+        stats = getattr(device, "memory_stats", lambda: None)()
+        if stats:
+            hbm_bytes = stats.get("bytes_limit", 0)
+    dims = _model_dims(context)
+    seq_len = int(sample.shape[-1]) if sample.ndim >= 2 else 0
+    activation_bytes = 0
+    if dims.get("hidden_size") and dims.get("num_layers") and seq_len:
+        # bf16 activations (2 bytes) saved for backward, per microbatch
+        activation_bytes = int(
+            micro_batch * seq_len * dims["num_layers"]
+            * dims["hidden_size"] * ACTIVATION_FACTOR * 2)
     return {
         "param_count": param_count,
         "param_bytes": param_bytes,
         "param_dtypes": dtypes,
         "train_state_bytes": train_state_bytes,
+        "activation_bytes": activation_bytes,
+        "seq_len": seq_len,
         "device_hbm_bytes": hbm_bytes,
         "n_devices": len(context.devices),
-        "fits_one_device": (hbm_bytes == 0
-                            or train_state_bytes < hbm_bytes * 0.8),
+        "fits_one_device": (
+            hbm_bytes == 0
+            or train_state_bytes < hbm_bytes * STATE_HBM_FRACTION),
+        **dims,
     }
+
+
+def _divisors_of(n: int):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def size_axes(info: Dict[str, Any]) -> Dict[str, Any]:
+    """Closed-form axis sizing from the analysis (reference role:
+    mip_tp_planner.py:30 sizes TP splits from graph + topology).
+
+    Policy (homogeneous TPU mesh):
+    1. fsdp: smallest divisor of n_devices whose shard of the train
+       state fits STATE_HBM_FRACTION of one device's HBM. (Tensor
+       parallelism cannot improve the STATE fit — weights shard over
+       fsdp × tensor either way — so state sizing is fsdp-only.)
+    2. remat: on when the per-microbatch activation footprint doesn't
+       fit the HBM left after the state shard; rematerialization keeps
+       roughly the residual stream (~15% of saved activations).
+    3. tensor: only when activations still don't fit AFTER remat —
+       sized to the smallest divisor of the remaining devices that
+       divides BOTH num_heads and num_kv_heads (Megatron head-split
+       constraint) and makes the width-sharded activations fit.
+    4. data: whatever devices remain.
+
+    Returns {"fsdp", "tensor", "data", "remat"}; all 1/False when the
+    device HBM is unknown (nothing to size against).
+    """
+    n_devices = info["n_devices"]
+    hbm = info["device_hbm_bytes"]
+    if not hbm or n_devices < 1:
+        return {"fsdp": 1, "tensor": 1, "data": n_devices or 1,
+                "remat": False}
+    state_budget = hbm * STATE_HBM_FRACTION
+    state = info["train_state_bytes"]
+
+    fsdp = next((d for d in _divisors_of(n_devices)
+                 if state / d <= state_budget), n_devices)
+
+    free_after_state = max(hbm - state / fsdp, hbm * 0.1)
+    act_budget = free_after_state * 0.8
+    act = float(info.get("activation_bytes", 0))
+    remat = bool(act and act > act_budget)
+    # remat keeps ~the residual stream: 2/ACTIVATION_FACTOR of the saved
+    # activations, recomputing the rest inside each layer
+    act_eff = act * (2.0 / ACTIVATION_FACTOR) if remat else act
+
+    tensor = 1
+    heads = info.get("num_heads", 0)
+    kv_heads = info.get("num_kv_heads", 0) or heads
+    if act_eff > act_budget and heads:
+        for d in _divisors_of(n_devices // fsdp):
+            if d > 1 and heads % d == 0 and kv_heads % d == 0:
+                tensor = d
+                if act_eff / d <= act_budget:
+                    break
+
+    data = n_devices // (fsdp * tensor)
+    return {"fsdp": fsdp, "tensor": tensor, "data": max(1, data),
+            "remat": remat}

@@ -1,8 +1,10 @@
 """Planner: prune the optimization space into candidate strategies.
 
-Capability parity: atorch Planner (auto/engine/planner.py:13) — analysis
-gates which optimizations are even considered (distributed passes need >1
-device; fsdp is forced when the train state can't fit one device).
+Capability parity: atorch Planner (auto/engine/planner.py:13) gating which
+optimizations are considered, PLUS the shard planners' axis sizing
+(mip_tp_planner.py:30): when the analysis can size axes (HBM known), the
+first candidates are model-aware sized configs — fsdp/tensor sizes and
+remat derived from the model and device topology — not bare pass names.
 """
 
 from __future__ import annotations
@@ -10,10 +12,39 @@ from __future__ import annotations
 from itertools import combinations
 from typing import List
 
-from dlrover_tpu.auto.engine.analyser import analyse
+from dlrover_tpu.auto.engine.analyser import analyse, size_axes
 from dlrover_tpu.auto.model_context import ModelContext
 from dlrover_tpu.auto.opt_lib import SEMIAUTO_STRATEGIES, OptimizationLibrary
 from dlrover_tpu.auto.strategy import Strategy
+
+
+def _sized_candidates(info, n_devices: int) -> List[Strategy]:
+    """Model-aware sized strategies, best-guess first plus neighbors."""
+    sizing = size_axes(info)
+    if sizing["fsdp"] <= 1 and not sizing["remat"]:
+        return []
+
+    def build(fsdp: int, tensor: int, remat: bool) -> Strategy:
+        strategy: Strategy = [("half", {}), ("module_replace", {})]
+        if fsdp > 1:
+            strategy.append(("fsdp", {"size": fsdp}))
+        if tensor > 1:
+            strategy.append(("tensor_parallel", {"size": tensor}))
+        if remat:
+            strategy.append(("checkpoint", {}))
+        return strategy
+
+    candidates = [build(sizing["fsdp"], sizing["tensor"], sizing["remat"])]
+    # neighbors: one rung more sharding (cheaper HBM, more comm) and the
+    # remat flip, so the dry-run can catch a mis-estimate
+    more_fsdp = sizing["fsdp"] * 2
+    if more_fsdp * sizing["tensor"] <= n_devices and (
+            n_devices % (more_fsdp * sizing["tensor"]) == 0):
+        candidates.append(build(more_fsdp, sizing["tensor"],
+                                sizing["remat"]))
+    candidates.append(build(sizing["fsdp"], sizing["tensor"],
+                            not sizing["remat"]))
+    return candidates
 
 
 def plan_candidates(context: ModelContext,
@@ -21,6 +52,10 @@ def plan_candidates(context: ModelContext,
     info = analyse(context)
     opt_lib = OptimizationLibrary()
     n_devices = info["n_devices"]
+
+    candidates: List[Strategy] = []
+    if n_devices > 1:
+        candidates.extend(_sized_candidates(info, n_devices))
 
     forced: Strategy = []
     if not info["fits_one_device"] and n_devices > 1:
@@ -37,7 +72,6 @@ def plan_candidates(context: ModelContext,
             continue
         optional.append(name)
 
-    candidates: List[Strategy] = []
     # smallest first: baseline (forced only), then singles, then pairs, ...
     for size in range(0, len(optional) + 1):
         for combo in combinations(optional, size):
@@ -45,7 +79,8 @@ def plan_candidates(context: ModelContext,
                     and n_devices < 4):
                 continue
             strategy = list(forced) + [(name, {}) for name in combo]
-            candidates.append(strategy)
+            if strategy not in candidates:
+                candidates.append(strategy)
             if len(candidates) >= max_candidates:
                 return candidates
     return candidates
