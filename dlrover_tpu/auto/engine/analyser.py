@@ -13,7 +13,6 @@ footprint.
 
 from __future__ import annotations
 
-import math
 import os
 from typing import Any, Dict
 
@@ -67,7 +66,10 @@ def analyse(context: ModelContext, micro_batch: int = 1) -> Dict[str, Any]:
     # master copy ⇒ ~16 bytes/param upper bound.
     train_state_bytes = param_count * 16
     device = context.devices[0]
-    hbm_bytes = int(os.environ.get("DLROVER_TPU_HBM_BYTES", 0))
+    try:
+        hbm_bytes = int(os.environ.get("DLROVER_TPU_HBM_BYTES") or 0)
+    except ValueError:
+        hbm_bytes = 0
     if not hbm_bytes:
         stats = getattr(device, "memory_stats", lambda: None)()
         if stats:

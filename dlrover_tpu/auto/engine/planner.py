@@ -55,7 +55,10 @@ def plan_candidates(context: ModelContext,
 
     candidates: List[Strategy] = []
     if n_devices > 1:
-        candidates.extend(_sized_candidates(info, n_devices))
+        candidates.extend(
+            _sized_candidates(info, n_devices)[:max_candidates])
+    if len(candidates) >= max_candidates:
+        return candidates[:max_candidates]
 
     forced: Strategy = []
     if not info["fits_one_device"] and n_devices > 1:
@@ -81,6 +84,6 @@ def plan_candidates(context: ModelContext,
             strategy = list(forced) + [(name, {}) for name in combo]
             if strategy not in candidates:
                 candidates.append(strategy)
-            if len(candidates) >= max_candidates:
-                return candidates
+                if len(candidates) >= max_candidates:
+                    return candidates
     return candidates
