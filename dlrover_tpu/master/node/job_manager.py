@@ -410,6 +410,16 @@ def create_job_manager(
             tpu_topology=job_args.tpu_topology,
         )
         watcher = K8sPodWatcher(client, job_args.job_name)
+    elif job_args.platform == PlatformType.RAY:
+        from dlrover_tpu.master.scaler.ray_scaler import RayScaler
+        from dlrover_tpu.master.watcher.ray_watcher import RayNodeWatcher
+        from dlrover_tpu.scheduler.ray import RayClient
+
+        client = cluster if cluster is not None else RayClient(
+            job_args.job_name)
+        scaler = RayScaler(job_args.job_name, client, master_addr,
+                           command=job_args.command)
+        watcher = RayNodeWatcher(client, job_args.job_name)
     else:
         raise ValueError(f"unsupported platform {job_args.platform!r}")
     return JobManager(job_args, scaler, watcher,

@@ -1,0 +1,253 @@
+"""Ray backend exercised with a fake `ray` module (reference test-strategy
+analogue: MockRayJobArgs, dlrover/python/tests/test_utils.py:112 — no real
+ray cluster; the plan→actor mapping is what's under test).
+
+Covers scheduler/ray.py (RayClient :51-ff parity), the RayScaler
+plan→actor mapping, the RayNodeWatcher status diffing, and the
+create_job_manager("ray") wiring.
+"""
+
+import sys
+import types
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.scaler.base import ScalePlan
+
+
+class _FakeFuture:
+    def __init__(self):
+        self.result = None
+        self.done = False
+
+
+class _FakeMethod:
+    def __init__(self, actor):
+        self._actor = actor
+
+    def remote(self, *args, **kwargs):
+        self._actor.calls.append((args, kwargs))
+        return self._actor.future
+
+
+class _FakeActor:
+    def __init__(self, cls, options):
+        self.cls = cls
+        self.options = options
+        self.calls = []
+        self.future = _FakeFuture()
+        self.killed = False
+        self.run = _FakeMethod(self)
+
+
+class _FakeActorClass:
+    def __init__(self, cls, options):
+        self._cls = cls
+        self._options = options
+        self.created = []
+
+    def remote(self, *args, **kwargs):
+        actor = _FakeActor(self._cls, self._options)
+        self.created.append(actor)
+        _FAKE_STATE["actors"].append(actor)
+        return actor
+
+
+_FAKE_STATE = {"actors": [], "initialized": False}
+
+
+def _build_fake_ray():
+    ray = types.ModuleType("ray")
+
+    def remote(**options):
+        def wrap(cls):
+            return _FakeActorClass(cls, options)
+
+        return wrap
+
+    def wait(futures, timeout=0):
+        ready = [f for f in futures if f.done]
+        return ready, [f for f in futures if not f.done]
+
+    def get(future):
+        if isinstance(future.result, Exception):
+            raise future.result
+        return future.result
+
+    def kill(actor):
+        actor.killed = True
+
+    ray.remote = remote
+    ray.wait = wait
+    ray.get = get
+    ray.kill = kill
+    ray.init = lambda **kw: _FAKE_STATE.update(initialized=True)
+    ray.is_initialized = lambda: _FAKE_STATE["initialized"]
+    return ray
+
+
+@pytest.fixture()
+def fake_ray(monkeypatch):
+    _FAKE_STATE["actors"] = []
+    _FAKE_STATE["initialized"] = False
+    ray = _build_fake_ray()
+    monkeypatch.setitem(sys.modules, "ray", ray)
+    return ray
+
+
+def _client(fake_ray):
+    from dlrover_tpu.scheduler.ray import RayClient
+
+    return RayClient("demo")
+
+
+def _group(count, cpu=2.0):
+    return NodeGroupResource(
+        count=count, node_resource=NodeResource(cpu=cpu))
+
+
+class TestRayClient:
+    def test_requires_ray(self, monkeypatch):
+        monkeypatch.setitem(sys.modules, "ray", None)
+        from dlrover_tpu.scheduler.ray import RayClient
+
+        with pytest.raises(RuntimeError, match="ray"):
+            RayClient("demo")
+
+    def test_actor_lifecycle_and_status(self, fake_ray):
+        client = _client(fake_ray)
+        handle = client.create_agent_actor(
+            NodeType.WORKER, 0, 0, "1.2.3.4:50001",
+            ["python", "train.py"], num_cpus=2.0)
+        assert fake_ray.is_initialized()
+        assert client.actor_status(handle.name) == NodeStatus.RUNNING
+        # the actor got the master address + entrypoint
+        (args, _), = handle.actor.calls
+        assert args == ("1.2.3.4:50001", 0, ["python", "train.py"])
+        # completion -> SUCCEEDED / FAILED
+        handle.actor.future.done = True
+        handle.actor.future.result = 0
+        assert client.actor_status(handle.name) == NodeStatus.SUCCEEDED
+        handle.actor.future.result = 1
+        assert client.actor_status(handle.name) == NodeStatus.FAILED
+        assert client.delete_actor(handle.name)
+        assert handle.actor.killed
+        assert client.actor_status(handle.name) == NodeStatus.DELETED
+
+
+class TestRayScaler:
+    def _scaler(self, fake_ray, command="python train.py --steps 10"):
+        from dlrover_tpu.master.scaler.ray_scaler import RayScaler
+
+        client = _client(fake_ray)
+        return RayScaler("demo", client, master_addr="m:1",
+                         command=command), client
+
+    def test_plan_to_actor_mapping(self, fake_ray):
+        """ScalePlan group sizes become exactly that many agent actors
+        with the job command as entrypoint."""
+        scaler, client = self._scaler(fake_ray)
+        plan = ScalePlan(
+            node_group_resources={NodeType.WORKER: _group(3)})
+        scaler.scale(plan)
+        handles = client.list_actors()
+        assert len(handles) == 3
+        assert sorted(h.rank_index for h in handles) == [0, 1, 2]
+        (args, _), = handles[0].actor.calls
+        assert args[0] == "m:1"
+        assert args[2] == ["python", "train.py", "--steps", "10"]
+        # actor resources come from the group resource
+        assert handles[0].actor.options["num_cpus"] == 2.0
+
+    def test_scale_down_removes_highest_ranks(self, fake_ray):
+        scaler, client = self._scaler(fake_ray)
+        scaler.scale(ScalePlan(
+            node_group_resources={NodeType.WORKER: _group(4)}))
+        scaler.scale(ScalePlan(
+            node_group_resources={NodeType.WORKER: _group(2)}))
+        handles = client.list_actors()
+        assert sorted(h.rank_index for h in handles) == [0, 1]
+
+    def test_relaunch_fills_rank_hole(self, fake_ray):
+        scaler, client = self._scaler(fake_ray)
+        scaler.scale(ScalePlan(
+            node_group_resources={NodeType.WORKER: _group(3)}))
+        victim = [h for h in client.list_actors()
+                  if h.rank_index == 1][0]
+        client.delete_actor(victim.name)
+        scaler.scale(ScalePlan(
+            node_group_resources={NodeType.WORKER: _group(3)}))
+        ranks = sorted(h.rank_index for h in client.list_actors())
+        assert ranks == [0, 1, 2]
+        # the replacement got a fresh node id
+        ids = sorted(h.node_id for h in client.list_actors())
+        assert ids == [0, 2, 3]
+
+    def test_missing_command_is_explicit(self, fake_ray):
+        scaler, _ = self._scaler(fake_ray, command="")
+        with pytest.raises(ValueError, match="command"):
+            scaler.scale(ScalePlan(
+                node_group_resources={NodeType.WORKER: _group(1)}))
+
+
+class TestRayWatcher:
+    def test_status_diff_events(self, fake_ray):
+        from dlrover_tpu.master.watcher.ray_watcher import RayNodeWatcher
+
+        client = _client(fake_ray)
+        handle = client.create_agent_actor(
+            NodeType.WORKER, 0, 0, "m:1", ["x"])
+        watcher = RayNodeWatcher(client, poll_interval_s=0.01)
+        events = watcher.watch()
+        first = next(events)
+        assert first.event_type == "ADDED"
+        assert first.node.status == NodeStatus.RUNNING
+        handle.actor.future.done = True
+        handle.actor.future.result = 1
+        second = next(events)
+        assert second.event_type == "MODIFIED"
+        assert second.node.status == NodeStatus.FAILED
+        client.delete_actor(handle.name)
+        third = next(events)
+        assert third.event_type == "DELETED"
+        watcher.stop()
+
+    def test_list_reports_nodes(self, fake_ray):
+        from dlrover_tpu.master.watcher.ray_watcher import RayNodeWatcher
+
+        client = _client(fake_ray)
+        client.create_agent_actor(NodeType.WORKER, 0, 0, "m:1", ["x"])
+        watcher = RayNodeWatcher(client)
+        nodes = watcher.list()
+        assert len(nodes) == 1 and nodes[0].type == NodeType.WORKER
+
+
+class TestRayJobManager:
+    def test_create_job_manager_ray_platform(self, fake_ray):
+        """create_job_manager('ray') wires RayScaler + RayNodeWatcher and
+        the initial scale plan creates the worker actors."""
+        from dlrover_tpu.master.node.job_manager import create_job_manager
+        from dlrover_tpu.master.speed_monitor import SpeedMonitor
+        from dlrover_tpu.scheduler.job import JobArgs, NodeArgs
+
+        args = JobArgs(platform="ray", job_name="demo",
+                       command="python train.py")
+        args.node_args[NodeType.WORKER] = NodeArgs(
+            group_resource=_group(2))
+        client = _client(fake_ray)
+        manager = create_job_manager(args, master_addr="m:1",
+                                     speed_monitor=SpeedMonitor(),
+                                     cluster=client)
+        manager.start()
+        try:
+            import time
+
+            deadline = time.time() + 5
+            while time.time() < deadline and len(
+                    client.list_actors()) < 2:
+                time.sleep(0.05)
+            assert len(client.list_actors()) == 2
+        finally:
+            manager.stop()
