@@ -150,13 +150,26 @@ def ring_attention(
 # ---------------------------------------------------------------------------
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
+                   block_impl: str = "auto"):
     """Per-device body: (B, L_local, H, D) → all_to_all → full-seq
     attention on H/axis_size heads → all_to_all back.
 
     GQA: when the KV head count divides the axis size, the SMALL k/v
     arrays ride the all_to_all and heads are replicated after (ICI moves
-    KV-sized bytes, not H-sized); otherwise KV is replicated up front."""
+    KV-sized bytes, not H-sized); otherwise KV is replicated up front.
+
+    The per-device attention is the Pallas flash kernel on TPU (O(L)
+    memory, MXU-rate blocks; GQA handled by the kernel's head grouping)
+    and the plain blockwise einsum elsewhere — `block_impl` forces one
+    ("flash" | "einsum") for tests."""
+    import jax as _jax
+
+    from dlrover_tpu.ops.flash_attention import flash_attention
+
+    use_flash = (block_impl == "flash"
+                 or (block_impl == "auto"
+                     and _jax.default_backend() == "tpu"))
     axis_size = lax.psum(1, axis_name)
 
     def seq_to_heads(x):
@@ -175,6 +188,14 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     q_full = seq_to_heads(q)
     k_full = seq_to_heads(k)
     v_full = seq_to_heads(v)
+    if use_flash:
+        # (B, L, H, D) → kernel layout (B, H, L, D); GQA head grouping
+        # happens inside the kernel's index maps — local q head j maps
+        # to local kv head j // rep, matching the einsum path's repeat
+        qt, kt, vt = (t.transpose(0, 2, 1, 3)
+                      for t in (q_full, k_full, v_full))
+        out = flash_attention(qt, kt, vt, causal, sm_scale=scale)
+        return heads_to_seq(out.transpose(0, 2, 1, 3))
     rep = q_full.shape[2] // k_full.shape[2]
     if rep > 1:
         # local q heads j map to local kv head j // rep — the same
@@ -204,12 +225,15 @@ def ulysses_attention(
     sm_scale: Optional[float] = None,
     batch_axes=(MeshAxis.DATA, MeshAxis.FSDP),
     head_axis: Optional[str] = None,
+    block_impl: str = "auto",
 ) -> jax.Array:
     """All-to-all sequence parallelism. q (B, S, H, D), k/v may carry
     fewer (GQA) heads. Lower latency than the ring for moderate sequence
     lengths: 2 all-to-alls instead of axis_size permutes. With
     `head_axis` (tensor parallelism) the per-device head group is divided
-    again by the sequence axis, composing SP × TP in one shard_map."""
+    again by the sequence axis, composing SP × TP in one shard_map.
+    block_impl: per-device attention kernel — "auto" (flash on TPU,
+    einsum elsewhere) | "flash" | "einsum"."""
     heads = q.shape[2]
     axis_size = mesh.shape[axis]
     tensor_size = mesh.shape[head_axis] if head_axis else 1
@@ -225,7 +249,7 @@ def ulysses_attention(
     spec = P(batch_axes, axis, head_axis, None)
     fn = shard_map(
         functools.partial(_ulysses_local, axis_name=axis, causal=causal,
-                          scale=scale),
+                          scale=scale, block_impl=block_impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

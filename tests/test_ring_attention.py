@@ -104,3 +104,38 @@ class TestUlyssesAttention:
         q, k, v = make_qkv(heads=3)
         with pytest.raises(ValueError, match="not divisible"):
             ulysses_attention(q, k, v, seq_mesh)
+
+
+class TestUlyssesFlashBlocks:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_flash_block_impl_matches_einsum(self, seq_mesh, causal):
+        """The per-device flash kernel (TPU path, interpret mode here)
+        must equal the einsum path — values and grads — incl. GQA."""
+        q, k, v = make_qkv(heads=4)
+        _, kg, vg = make_qkv(heads=4, seed=1)
+        kg, vg = kg[:, :, :2], vg[:, :, :2]      # 2 kv heads (GQA)
+
+        for kk, vv in ((k, v), (kg, vg)):
+            expected = ulysses_attention(q, kk, vv, seq_mesh,
+                                         causal=causal,
+                                         block_impl="einsum")
+            got = ulysses_attention(q, kk, vv, seq_mesh, causal=causal,
+                                    block_impl="flash")
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(expected),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_flash_block_impl_grads(self, seq_mesh):
+        q, k, v = make_qkv()
+
+        def loss(impl, *args):
+            return jnp.sum(ulysses_attention(
+                *args, seq_mesh, causal=True, block_impl=impl) ** 2)
+
+        g_flash = jax.grad(lambda *a: loss("flash", *a),
+                           argnums=(0, 1, 2))(q, k, v)
+        g_einsum = jax.grad(lambda *a: loss("einsum", *a),
+                            argnums=(0, 1, 2))(q, k, v)
+        for gf, ge in zip(g_flash, g_einsum):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(ge),
+                                       atol=1e-4, rtol=1e-4)
