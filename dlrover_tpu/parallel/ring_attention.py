@@ -81,25 +81,42 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
     groups = heads // k.shape[2]
 
     q32 = q.astype(jnp.float32)
-    positions_q = my_idx * l_local + jnp.arange(l_local)
+
+    diag_mask = jnp.where(
+        jnp.arange(l_local)[None, :] > jnp.arange(l_local)[:, None],
+        _NEG_INF, 0.0).astype(jnp.float32)
 
     def step(carry, i):
         o, m, l, k_blk, v_blk = carry
         kv_idx = (my_idx - i) % axis_size
-        if causal:
-            positions_k = kv_idx * l_local + jnp.arange(l_local)
-            mask = jnp.where(
-                positions_k[None, :] > positions_q[:, None], _NEG_INF, 0.0
-            ).astype(jnp.float32)
-        else:
-            mask = None
         if groups > 1:
             k_rep = jnp.repeat(k_blk, groups, axis=2)
             v_rep = jnp.repeat(v_blk, groups, axis=2)
         else:
             k_rep, v_rep = k_blk, v_blk
-        o_new, m_new, l_new = _block_attn(q32, k_rep, v_rep, scale, mask)
-        o, m, l = _online_merge(o, m, l, o_new, m_new, l_new)
+
+        def merge(mask):
+            o_new, m_new, l_new = _block_attn(q32, k_rep, v_rep, scale,
+                                              mask)
+            return _online_merge(o, m, l, o_new, m_new, l_new)
+
+        if causal:
+            # Three block kinds per step: diagonal (causal mask), fully
+            # visible past block (no mask), fully masked future block
+            # (skipped — its softmax weight is exactly zero). The switch
+            # predicate varies per device, which is fine here: this
+            # shard_map is fully manual, so the branches are pure local
+            # compute with no collectives to diverge on. Skipping future
+            # blocks halves the causal ring's compute.
+            branch = jnp.where(kv_idx == my_idx, 0,
+                               jnp.where(kv_idx < my_idx, 1, 2))
+            o, m, l = lax.switch(branch, [
+                lambda _: merge(diag_mask),
+                lambda _: merge(None),
+                lambda _: (o, m, l),
+            ], None)
+        else:
+            o, m, l = merge(None)
         # rotate K/V to the next device; the permute of step i+1 overlaps
         # this step's matmuls (independent DMA)
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
