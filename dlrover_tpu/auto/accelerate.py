@@ -190,6 +190,7 @@ def lower(context: ModelContext) -> AccelerateResult:
         micro_batch=micro,
         rules=rules,
         donate_state=plan.donate_state,
+        offload_opt_state=plan.offload_optimizer,
     )
     return AccelerateResult(trainer=trainer, mesh=mesh,
                             model=context.model, strategy=[],

@@ -43,6 +43,8 @@ class AccelerationPlan:
     micro_batch: int = 0                     # 0 = derive from global batch
     global_batch: int = 0
     donate_state: bool = True
+    # optimizer moments in host memory (reference: adam_offload)
+    offload_optimizer: bool = False
     extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 

@@ -107,6 +107,18 @@ class ModuleReplaceOptimization(Optimization):
         context.plan.flash_attention = True
 
 
+class OffloadOptimizerOptimization(Optimization):
+    """Optimizer state in host memory (reference: Adam w/ CPU offload,
+    atorch/optim/adam_offload.py). TPU re-design: the moments' shardings
+    carry the pinned_host memory kind; XLA inserts the host↔HBM
+    transfers around the update — no custom optimizer needed."""
+
+    name = "offload_optimizer"
+
+    def apply(self, context, config):
+        context.plan.offload_optimizer = True
+
+
 class TensorParallelOptimization(Optimization):
     """Megatron-style TP: column/row splits come from the logical-axis rule
     table, no module surgery. config: {"size": N}."""
@@ -223,12 +235,14 @@ class OptimizationLibrary:
             PipelineParallelOptimization,
             MixedParallelOptimization,
             ThreeDParallelOptimization,
+            OffloadOptimizerOptimization,
         ):
             opt = opt_cls()
             self.opts[opt.name] = opt
         # atorch aliases
         self.opts["remat"] = self.opts["checkpoint"]
         self.opts["amp_native"] = self.opts["amp"]
+        self.opts["adam_offload"] = self.opts["offload_optimizer"]
 
     def __getitem__(self, name: str) -> Optimization:
         return self.opts[name]

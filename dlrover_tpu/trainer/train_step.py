@@ -75,11 +75,19 @@ def build_trainer(
     micro_batch: int = 1,
     rules: Optional[Sequence] = None,
     donate_state: bool = True,
+    offload_opt_state: bool = False,
 ) -> ShardedTrainer:
     """Lower (model, optimizer, mesh) into init/step programs.
 
     sample_batch: one microbatch of tokens, shape (micro_batch, seq) — used
     only for shape inference.
+
+    offload_opt_state: keep the optimizer state in HOST memory
+    (pinned_host memory kind) — the TPU-native equivalent of the
+    reference's CPU-offloaded Adam (atorch/optim/adam_offload.py): the
+    moments' shardings carry the host memory kind and XLA inserts the
+    host↔HBM transfers around the update, freeing ~2/3 of the train
+    state's HBM at the cost of PCIe/DMA traffic per step.
     """
     rules = list(rules if rules is not None else DEFAULT_RULES)
 
@@ -101,6 +109,16 @@ def build_trainer(
             _init_boxed, jax.random.key(0)
         )
     state_shardings = mesh_shardings(abstract_boxed, mesh, rules)
+    if offload_opt_state:
+        abstract_opt = nn.unbox(abstract_boxed).opt_state
+        state_shardings = state_shardings.replace(
+            opt_state=jax.tree.map(
+                # scalars (step counters) stay on device: XLA's SPMD
+                # partitioner rejects memory-kind annotations on them
+                lambda s, a: s if a.ndim == 0 else NamedSharding(
+                    mesh, s.spec, memory_kind="pinned_host"),
+                state_shardings.opt_state, abstract_opt,
+            ))
     # Batch (accum, micro, seq): micro over the joint dp axes, seq over the
     # sequence axis (a no-op at sequence=1; shards inputs for SP runs).
     batch_shard = NamedSharding(
