@@ -57,23 +57,36 @@ class ElasticTrainLoop:
         config: TrainLoopConfig,
         master_client=None,
         devices=None,
+        trainer=None,
     ):
+        """`trainer` overrides the built dense trainer with any object
+        exposing the ShardedTrainer surface (init/abstract_state/step/
+        shard_batch/accum_steps/micro_batch) — e.g. a PipelinedTrainer,
+        making pipeline training elastic with checkpoint-resume."""
         self.config = config
         self.client = master_client
-        self.mesh = create_mesh(config.mesh_spec, devices)
-        self.dp = dp_size(self.mesh)
-        self.accum, self.micro_global = choose_accumulation(
-            config.global_batch, self.dp,
-            config.max_micro_per_replica,
-        )
-        import jax.numpy as jnp
+        if trainer is not None:
+            self.trainer = trainer
+            self.mesh = trainer.mesh
+            self.dp = dp_size(self.mesh)
+            self.accum = trainer.accum_steps
+            self.micro_global = trainer.micro_batch
+        else:
+            self.mesh = create_mesh(config.mesh_spec, devices)
+            self.dp = dp_size(self.mesh)
+            self.accum, self.micro_global = choose_accumulation(
+                config.global_batch, self.dp,
+                config.max_micro_per_replica,
+            )
+            import jax.numpy as jnp
 
-        sample = jnp.zeros((self.micro_global, config.seq_len), jnp.int32)
-        self.trainer = build_trainer(
-            model, tx, self.mesh, sample, loss_fn,
-            accum_steps=self.accum, micro_batch=self.micro_global,
-            rules=config.rules,
-        )
+            sample = jnp.zeros((self.micro_global, config.seq_len),
+                               jnp.int32)
+            self.trainer = build_trainer(
+                model, tx, self.mesh, sample, loss_fn,
+                accum_steps=self.accum, micro_batch=self.micro_global,
+                rules=config.rules,
+            )
         self.checkpointer = (
             FlashCheckpointer(config.checkpoint_dir,
                               config.save_interval_steps)
@@ -109,12 +122,7 @@ class ElasticTrainLoop:
         of params+optimizer state in HBM."""
         if self.checkpointer is None:
             return self.trainer.init(rng), 0
-        abstract = jax.tree.map(
-            lambda leaf, sharding: jax.ShapeDtypeStruct(
-                leaf.shape, leaf.dtype, sharding=sharding),
-            jax.eval_shape(self.trainer.init_fn, rng),
-            self.trainer.state_shardings,
-        )
+        abstract = self.trainer.abstract_state(rng)
         restored = self.checkpointer.restore(abstract)
         if restored is None:
             return self.trainer.init(rng), 0

@@ -315,16 +315,17 @@ class PipelinedTrainer:
             stacked)
         return {"shared": spec.init_shared(r_shared), "chunks": stacked}
 
-    def init(self, rng: jax.Array) -> TrainState:
+    def _make_state(self, rng):
+        params = self._make_params(rng)
+        return TrainState(step=jnp.zeros((), jnp.int32),
+                          params=params,
+                          opt_state=self._tx.init(params))
+
+    def _ensure_shardings(self, rng) -> None:
+        if self.state_shardings is not None:
+            return
         _ = self.layers_per_chunk   # validate divisibility eagerly
-
-        def make_state(rng):
-            params = self._make_params(rng)
-            return TrainState(step=jnp.zeros((), jnp.int32),
-                              params=params,
-                              opt_state=self._tx.init(params))
-
-        abstract = jax.eval_shape(make_state, rng)
+        abstract = jax.eval_shape(self._make_state, rng)
         param_shardings = self._param_shardings()
         flat_params = {
             tuple(str(getattr(k, "key", k)) for k in path): sharding
@@ -347,8 +348,21 @@ class PipelinedTrainer:
 
         self.state_shardings = jax.tree_util.tree_map_with_path(
             for_path, abstract)
+
+    def abstract_state(self, rng: jax.Array) -> TrainState:
+        """Abstract TrainState (shapes + shardings) — the checkpoint
+        restore target, same surface as ShardedTrainer."""
+        self._ensure_shardings(rng)
+        abstract = jax.eval_shape(self._make_state, rng)
+        return jax.tree.map(
+            lambda leaf, sharding: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=sharding),
+            abstract, self.state_shardings)
+
+    def init(self, rng: jax.Array) -> TrainState:
+        self._ensure_shardings(rng)
         # jit with out_shardings: nothing ever materializes replicated
-        return jax.jit(make_state,
+        return jax.jit(self._make_state,
                        out_shardings=self.state_shardings)(rng)
 
     # -- data -----------------------------------------------------------

@@ -52,6 +52,15 @@ class ShardedTrainer:
     def init(self, rng: jax.Array) -> TrainState:
         return self.init_fn(rng)
 
+    def abstract_state(self, rng: jax.Array) -> TrainState:
+        """Abstract TrainState (shapes + shardings, nothing allocated) —
+        the checkpoint-restore target (reshard-on-restore)."""
+        abstract = jax.eval_shape(self.init_fn, rng)
+        return jax.tree.map(
+            lambda leaf, sharding: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=sharding),
+            abstract, self.state_shardings)
+
     def step(self, state: TrainState, tokens, targets):
         return self.step_fn(state, tokens, targets)
 

@@ -99,3 +99,54 @@ def test_global_batch_held_fixed():
         accum, micro = choose_accumulation(32, dp, max_micro_per_replica=4)
         assert accum * micro == 32
         assert micro // dp <= 4
+
+
+def test_pipeline_trainer_through_elastic_loop(cpu_devices, tmp_path):
+    """PP is elastic too: the loop drives a PipelinedTrainer (external
+    trainer surface) with flash checkpointing, and a fresh loop resumes
+    from the committed step with resharded state."""
+    import optax
+
+    from dlrover_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        cross_entropy_loss,
+    )
+    from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
+    from dlrover_tpu.trainer.elastic_loop import (
+        ElasticTrainLoop,
+        TrainLoopConfig,
+    )
+    from dlrover_tpu.trainer.pipeline_trainer import build_pipeline_trainer
+
+    cfg = LlamaConfig.tiny(attn_impl="reference", dtype=jnp.float32)
+    ckpt = str(tmp_path / "pp-ckpt")
+
+    def make_loop():
+        mesh = create_mesh(MeshSpec(data=2, pipe=2), cpu_devices[:4])
+        trainer = build_pipeline_trainer(
+            cfg, optax.adam(1e-3), mesh, num_microbatches=2,
+            micro_batch=4, seq_len=16, loss_fn=cross_entropy_loss)
+        return ElasticTrainLoop(
+            None, None, None,
+            TrainLoopConfig(global_batch=8, seq_len=16,
+                            checkpoint_dir=ckpt, save_interval_steps=2),
+            trainer=trainer,
+        )
+
+    loop = make_loop()
+    state, start = loop.restore_or_init(jax.random.PRNGKey(0))
+    assert start == 0
+    state, metrics = loop.run(state, _batches(cfg, 8, 16, 4))
+    loop.close()
+
+    loop2 = make_loop()
+    state2, start2 = loop2.restore_or_init(jax.random.PRNGKey(1))
+    assert start2 == 4
+    # restored chunk params keep their pipe sharding
+    leaf = jax.tree.leaves(state2.params["chunks"])[0]
+    assert leaf.sharding.spec[1] == "pipe"
+    state2, metrics2 = loop2.run(state2, _batches(cfg, 8, 16, 2, seed=1),
+                                 start_step=start2)
+    assert np.isfinite(metrics2["loss"])
+    loop2.close()
