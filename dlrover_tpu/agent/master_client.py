@@ -222,6 +222,17 @@ class MasterClient:
             node_id=self.node_id, node_rank=self.node_rank, addr=addr,
         )).success
 
+    def report_model_info(self, param_count: int, param_bytes: int,
+                          flops_per_step: float = 0.0,
+                          batch_size: int = 0, seq_len: int = 0) -> bool:
+        """Static model stats for the resource optimizer (reference:
+        profile_extractor reporting ModelInfo)."""
+        return self._report(msg.ModelInfo(
+            param_count=param_count, param_bytes=param_bytes,
+            flops_per_step=flops_per_step, batch_size=batch_size,
+            seq_len=seq_len,
+        )).success
+
     def get_paral_config(self) -> msg.ParallelConfig:
         return self._get_typed(
             msg.ParallelConfigRequest(node_id=self.node_id),

@@ -46,6 +46,14 @@ class TrainLoopConfig:
     report_interval_steps: int = 10
     mesh_spec: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     rules: Optional[Any] = None
+    # jax.profiler trace window (reference tracing parity, SURVEY §5a):
+    # a perfetto/xplane trace of steps [start, start+num) is written to
+    # profile_dir (defaults to $DLROVER_TPU_PROFILE_DIR)
+    profile_dir: str = dataclasses.field(
+        default_factory=lambda: __import__("os").environ.get(
+            "DLROVER_TPU_PROFILE_DIR", ""))
+    profile_start_step: int = 3           # skip compile steps
+    profile_num_steps: int = 3
 
 
 class ElasticTrainLoop:
@@ -94,11 +102,34 @@ class ElasticTrainLoop:
         )
         self._stop_requested = threading.Event()
         self._prev_sigterm = None
+        self._profiling = False
         logger.info(
             "elastic loop: dp=%d accum=%d micro(global)=%d mesh=%s",
             self.dp, self.accum, self.micro_global,
             dict(self.mesh.shape),
         )
+        self._report_model_info()
+
+    def _report_model_info(self) -> None:
+        """One-shot static stats to the master's resource optimizer
+        (reference: profile_extractor → ModelInfo)."""
+        if self.client is None:
+            return
+        try:
+            abstract = self.trainer.abstract_state(jax.random.PRNGKey(0))
+            leaves = jax.tree.leaves(abstract.params)
+            param_count = sum(int(np.prod(l.shape)) for l in leaves)
+            param_bytes = sum(
+                int(np.prod(l.shape)) * l.dtype.itemsize for l in leaves)
+            tokens_per_step = self.config.global_batch * self.config.seq_len
+            self.client.report_model_info(
+                param_count=param_count, param_bytes=param_bytes,
+                flops_per_step=6.0 * param_count * tokens_per_step,
+                batch_size=self.config.global_batch,
+                seq_len=self.config.seq_len,
+            )
+        except Exception:   # noqa: BLE001 — stats are advisory
+            logger.warning("model-info report failed", exc_info=True)
 
     # -- signals -----------------------------------------------------------
     def install_signal_handler(self) -> None:
@@ -150,6 +181,7 @@ class ElasticTrainLoop:
         step = start_step
         raw_metrics: Dict[str, Any] = {}
         for tokens, targets in batches:
+            self._maybe_profile(step - start_step)
             tok, tgt = self.trainer.shard_batch(tokens, targets)
             state, raw_metrics = self.trainer.step(state, tok, tgt)
             step += 1
@@ -172,9 +204,29 @@ class ElasticTrainLoop:
             if config.max_steps and step - start_step >= config.max_steps:
                 break
         metrics = {k: float(v) for k, v in raw_metrics.items()}
+        self._stop_profile()
         if self.checkpointer is not None:
             self.checkpointer.wait()
         return state, metrics
+
+    # -- profiling ---------------------------------------------------------
+    def _maybe_profile(self, local_step: int) -> None:
+        config = self.config
+        if not config.profile_dir:
+            return
+        if local_step == config.profile_start_step and not self._profiling:
+            logger.info("profiler: tracing %d steps to %s",
+                        config.profile_num_steps, config.profile_dir)
+            jax.profiler.start_trace(config.profile_dir)
+            self._profiling = True
+        elif self._profiling and local_step >= (
+                config.profile_start_step + config.profile_num_steps):
+            self._stop_profile()
+
+    def _stop_profile(self) -> None:
+        if self._profiling:
+            jax.profiler.stop_trace()
+            self._profiling = False
 
     def _data_state(self, sampler) -> Dict[str, Any]:
         data_state: Dict[str, Any] = {}
