@@ -35,6 +35,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# v5e-tuned default block sizes (92 TF/s fwd vs 11 at 128×128); capped by
+# the actual sequence length via fit_block. Shared with the ring-flash
+# path (parallel/ring_attention.py).
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -292,7 +298,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(res, g, *, sm_scale: float, causal: bool,
-               block_q: int, block_k: int):
+               block_q: int, block_k: int, delta=None):
+    """delta = rowsum(dO·O) may be passed precomputed — ring callers
+    invoke this once per visiting KV block with step-invariant dO/O."""
     q, k, v, out, lse = res
     do = g
     batch, num_heads, seq_q, head_dim = q.shape
@@ -303,8 +311,9 @@ def _flash_bwd(res, g, *, sm_scale: float, causal: bool,
     num_q_blocks = _cdiv(seq_q, block_q)
     num_k_blocks = _cdiv(seq_k, block_k)
 
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # (b, h, seq_q, 1)
+    if delta is None:
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)  # (b, h, seq_q, 1)
 
     def q_map(b, h, qi, ki):
         return (b, h, qi, 0)

@@ -17,9 +17,11 @@ TPU re-design: the sequence dim is a mesh axis under `shard_map`.
   re-shards back (head-parallel SP; absent in the reference snapshot —
   noted in SURVEY.md §2.4).
 
-Both are pure jax.lax collectives: autodiff derives the backward pass
-(ppermute/all_to_all have transpose rules), and `jax.checkpoint` composes
-for memory.
+The einsum paths are pure jax.lax collectives (autodiff derives the
+backward; ppermute/all_to_all have transpose rules). The TPU-default
+flash paths are NOT: the ring's is a custom VJP over Pallas kernels
+(forward-mode AD unsupported there), and Ulysses calls the flash
+kernel's own custom VJP.
 """
 
 from __future__ import annotations
@@ -36,6 +38,13 @@ from jax import shard_map
 from dlrover_tpu.common.constants import MeshAxis
 
 _NEG_INF = -1e30
+
+
+def _use_flash_blocks(block_impl: str) -> bool:
+    """Per-device attention kernel dispatch shared by ring and Ulysses:
+    "auto" = flash kernel on TPU, einsum elsewhere."""
+    return block_impl == "flash" or (
+        block_impl == "auto" and jax.default_backend() == "tpu")
 
 
 def _block_attn(q, k, v, scale, mask):
@@ -70,11 +79,20 @@ def _online_merge(o, m, l, o_new, m_new, l_new):
 
 
 def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
-                     scale: float):
+                     scale: float, block_impl: str = "auto"):
     """Per-device body under shard_map. q: (B, L_local, H, D); k/v may
     carry fewer (GQA) heads — only the small KV shards rotate around the
-    ring; the head replication happens locally per block, so ppermute
-    traffic is not multiplied by the group count."""
+    ring; the head replication happens locally per block (einsum path)
+    or inside the kernel's GQA index maps (flash path), so ppermute
+    traffic is not multiplied by the group count.
+
+    block_impl: "auto" (flash kernel on TPU, einsum elsewhere) |
+    "flash" | "einsum"."""
+    if _use_flash_blocks(block_impl):
+        # kernel layout (B, H, L, D); custom-VJP ring-flash path
+        qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        out = _ring_flash_local(qt, kt, vt, axis_name, causal, scale)
+        return out.transpose(0, 2, 1, 3)
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     batch, l_local, heads, dim = q.shape
@@ -134,6 +152,153 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Ring attention on the flash kernel (MXU-rate blocks, O(L_local) memory)
+# ---------------------------------------------------------------------------
+#
+# The einsum ring above materializes (L_local × L_local) block scores; the
+# flash path runs the Pallas kernel per visiting KV block and merges the
+# NORMALIZED per-block outputs via their logsumexp — the standard
+# ring-flash construction. Autodiff cannot see through pallas_call, so the
+# backward is a custom VJP: a second ring pass where each visiting KV
+# block's (dk, dv) accumulator travels around the ring WITH the block and
+# arrives home after S rotations; per-block grads come from the flash
+# backward kernels evaluated with the FINAL global lse (which makes each
+# block's softmax weights exact).
+
+
+def _merge_normalized(o, lse, o_b, lse_b):
+    """Merge (normalized out, lse) accumulators; -inf lse = empty."""
+    lse_n = jnp.logaddexp(lse, lse_b)
+    w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - lse_n), 0.0)
+    w_new = jnp.where(jnp.isfinite(lse_b), jnp.exp(lse_b - lse_n), 0.0)
+    return o * w_old + o_b * w_new, lse_n
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale):
+    """q (B,H,L,D), k/v (B,KV,L,D) kernel layout; returns (out, lse)."""
+    from dlrover_tpu.ops.flash_attention import _flash_fwd
+
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    fwd_perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    batch, heads, l_local, dim = q.shape
+
+    def block(flag):
+        def run(kv):
+            from dlrover_tpu.ops.flash_attention import (
+                DEFAULT_BLOCK_K,
+                DEFAULT_BLOCK_Q,
+            )
+
+            o_b, lse_b = _flash_fwd(q, kv[0], kv[1], scale, flag,
+                                    DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+            return o_b.astype(jnp.float32), lse_b
+
+        return run
+
+    def step(carry, i):
+        o, lse, kb, vb = carry
+        kv_idx = (my_idx - i) % axis_size
+        if causal:
+            branch = jnp.where(kv_idx == my_idx, 0,
+                               jnp.where(kv_idx < my_idx, 1, 2))
+            o_b, lse_b = lax.switch(branch, [
+                block(True),            # diagonal: causal mask
+                block(False),           # fully visible past block
+                lambda kv: (jnp.zeros(q.shape, jnp.float32),
+                            jnp.full((batch, heads, l_local, 1),
+                                     -jnp.inf, jnp.float32)),
+            ], (kb, vb))
+        else:
+            o_b, lse_b = block(False)((kb, vb))
+        o, lse = _merge_normalized(o, lse, o_b, lse_b)
+        kb = lax.ppermute(kb, axis_name, fwd_perm)
+        vb = lax.ppermute(vb, axis_name, fwd_perm)
+        return (o, lse, kb, vb), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((batch, heads, l_local, 1), -jnp.inf, jnp.float32)
+    (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v),
+                                 jnp.arange(axis_size))
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash_local(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, res, g):
+    from dlrover_tpu.ops.flash_attention import _flash_bwd
+
+    q, k, v, out, lse = res
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    fwd_perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    # step-invariant: rowsum(dO·O), computed once for the whole ring
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    def block(flag):
+        def run(kv):
+            from dlrover_tpu.ops.flash_attention import (
+                DEFAULT_BLOCK_K,
+                DEFAULT_BLOCK_Q,
+            )
+
+            dq_b, dk_b, dv_b = _flash_bwd(
+                (q, kv[0], kv[1], out, lse), g, sm_scale=scale,
+                causal=flag, block_q=DEFAULT_BLOCK_Q,
+                block_k=DEFAULT_BLOCK_K, delta=delta)
+            return (dq_b.astype(jnp.float32), dk_b.astype(jnp.float32),
+                    dv_b.astype(jnp.float32))
+
+        return run
+
+    def zeros(kv):
+        return (jnp.zeros(q.shape, jnp.float32),
+                jnp.zeros(k.shape, jnp.float32),
+                jnp.zeros(v.shape, jnp.float32))
+
+    def step(carry, i):
+        dq, kb, vb, dkb, dvb = carry
+        kv_idx = (my_idx - i) % axis_size
+        if causal:
+            branch = jnp.where(kv_idx == my_idx, 0,
+                               jnp.where(kv_idx < my_idx, 1, 2))
+            dq_b, dk_b, dv_b = lax.switch(
+                branch, [block(True), block(False), zeros], (kb, vb))
+        else:
+            dq_b, dk_b, dv_b = block(False)((kb, vb))
+        dq = dq + dq_b
+        dkb = dkb + dk_b
+        dvb = dvb + dv_b
+        # the (dk, dv) accumulators travel WITH their kv block; after
+        # axis_size rotations both are back at the block's owner
+        kb = lax.ppermute(kb, axis_name, fwd_perm)
+        vb = lax.ppermute(vb, axis_name, fwd_perm)
+        dkb = lax.ppermute(dkb, axis_name, fwd_perm)
+        dvb = lax.ppermute(dvb, axis_name, fwd_perm)
+        return (dq, kb, vb, dkb, dvb), None
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (dq0, k, v, dk0, dv0), jnp.arange(axis_size))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash_local.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -144,16 +309,18 @@ def ring_attention(
     sm_scale: Optional[float] = None,
     batch_axes=(MeshAxis.DATA, MeshAxis.FSDP),
     head_axis: Optional[str] = MeshAxis.TENSOR,
+    block_impl: str = "auto",
 ) -> jax.Array:
     """Full-array API: q (B, S, H, D), k/v (B, S, KV, D) with KV ≤ H (GQA),
     all sharded S over `axis`; returns the attention output with q's
     sharding. Composes with tensor parallelism (heads over `head_axis`)
-    in one shard_map."""
+    in one shard_map. block_impl selects the per-block kernel ("auto":
+    flash on TPU, einsum elsewhere)."""
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     spec = P(batch_axes, axis, head_axis, None)
     fn = shard_map(
         functools.partial(_ring_attn_local, axis_name=axis, causal=causal,
-                          scale=scale),
+                          scale=scale, block_impl=block_impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -180,13 +347,9 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
     memory, MXU-rate blocks; GQA handled by the kernel's head grouping)
     and the plain blockwise einsum elsewhere — `block_impl` forces one
     ("flash" | "einsum") for tests."""
-    import jax as _jax
-
     from dlrover_tpu.ops.flash_attention import flash_attention
 
-    use_flash = (block_impl == "flash"
-                 or (block_impl == "auto"
-                     and _jax.default_backend() == "tpu"))
+    use_flash = _use_flash_blocks(block_impl)
     axis_size = lax.psum(1, axis_name)
 
     def seq_to_heads(x):
