@@ -162,6 +162,10 @@ def lower(context: ModelContext) -> AccelerateResult:
                 "(LlamaConfig or GPTConfig); for custom models build a "
                 "PipelineModelSpec and a PipelinedTrainer directly "
                 "(dlrover_tpu.trainer.pipeline_trainer)")
+        if plan.offload_optimizer:
+            logger.warning(
+                "offload_optimizer is not implemented for the pipeline "
+                "trainer yet; optimizer state stays in device memory")
         if plan.global_batch:
             # the accumulation geometry IS the microbatch stream: the
             # user's global batch is authoritative (accum × micro rows)

@@ -18,6 +18,7 @@ step reporting, the checkpoint hook the reference left unimplemented
 from __future__ import annotations
 
 import dataclasses
+import os
 import signal
 import threading
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
@@ -50,7 +51,7 @@ class TrainLoopConfig:
     # a perfetto/xplane trace of steps [start, start+num) is written to
     # profile_dir (defaults to $DLROVER_TPU_PROFILE_DIR)
     profile_dir: str = dataclasses.field(
-        default_factory=lambda: __import__("os").environ.get(
+        default_factory=lambda: os.environ.get(
             "DLROVER_TPU_PROFILE_DIR", ""))
     profile_start_step: int = 3           # skip compile steps
     profile_num_steps: int = 3
@@ -180,6 +181,19 @@ class ElasticTrainLoop:
         config = self.config
         step = start_step
         raw_metrics: Dict[str, Any] = {}
+        try:
+            return self._run_inner(state, batches, start_step, sampler,
+                                   raw_metrics)
+        finally:
+            # a step failure (the expected failure mode here) must still
+            # flush an active profiler trace, or the next loop's
+            # start_trace raises on the dangling session
+            self._stop_profile()
+
+    def _run_inner(self, state, batches, start_step, sampler,
+                   raw_metrics):
+        config = self.config
+        step = start_step
         for tokens, targets in batches:
             self._maybe_profile(step - start_step)
             tok, tgt = self.trainer.shard_batch(tokens, targets)
@@ -204,7 +218,6 @@ class ElasticTrainLoop:
             if config.max_steps and step - start_step >= config.max_steps:
                 break
         metrics = {k: float(v) for k, v in raw_metrics.items()}
-        self._stop_profile()
         if self.checkpointer is not None:
             self.checkpointer.wait()
         return state, metrics

@@ -352,12 +352,13 @@ class PipelinedTrainer:
     def abstract_state(self, rng: jax.Array) -> TrainState:
         """Abstract TrainState (shapes + shardings) — the checkpoint
         restore target, same surface as ShardedTrainer."""
+        from dlrover_tpu.trainer.train_step import (
+            abstract_state_with_shardings,
+        )
+
         self._ensure_shardings(rng)
-        abstract = jax.eval_shape(self._make_state, rng)
-        return jax.tree.map(
-            lambda leaf, sharding: jax.ShapeDtypeStruct(
-                leaf.shape, leaf.dtype, sharding=sharding),
-            abstract, self.state_shardings)
+        return abstract_state_with_shardings(
+            jax.eval_shape(self._make_state, rng), self.state_shardings)
 
     def init(self, rng: jax.Array) -> TrainState:
         self._ensure_shardings(rng)

@@ -26,6 +26,15 @@ from dlrover_tpu.parallel.sharding import (
 )
 
 
+def abstract_state_with_shardings(abstract: Any, shardings: Any) -> Any:
+    """Attach shardings to an eval_shape'd state tree — the checkpoint
+    restore target shared by the dense and pipelined trainers."""
+    return jax.tree.map(
+        lambda leaf, sharding: jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=sharding),
+        abstract, shardings)
+
+
 @flax.struct.dataclass
 class TrainState:
     step: jax.Array
@@ -55,11 +64,8 @@ class ShardedTrainer:
     def abstract_state(self, rng: jax.Array) -> TrainState:
         """Abstract TrainState (shapes + shardings, nothing allocated) —
         the checkpoint-restore target (reshard-on-restore)."""
-        abstract = jax.eval_shape(self.init_fn, rng)
-        return jax.tree.map(
-            lambda leaf, sharding: jax.ShapeDtypeStruct(
-                leaf.shape, leaf.dtype, sharding=sharding),
-            abstract, self.state_shardings)
+        return abstract_state_with_shardings(
+            jax.eval_shape(self.init_fn, rng), self.state_shardings)
 
     def step(self, state: TrainState, tokens, targets):
         return self.step_fn(state, tokens, targets)

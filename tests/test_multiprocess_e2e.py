@@ -54,15 +54,25 @@ def test_two_process_distributed_training(tmp_path):
         stderr=subprocess.STDOUT, text=True,
     )
     agents = []
-    try:
-        addr = ""
-        deadline = time.time() + 60
+    addr_box = {}
+
+    def drain():
+        # read master output for the address, then keep draining so the
+        # pipe never fills and blocks the master
         for line in master.stdout:
-            if "DLROVER_TPU_MASTER_ADDR=" in line:
-                addr = line.split("=", 1)[1].strip()
-                break
-            if time.time() > deadline:
-                break
+            if "addr" not in addr_box and \
+                    "DLROVER_TPU_MASTER_ADDR=" in line:
+                addr_box["addr"] = line.split("=", 1)[1].strip()
+
+    import threading
+
+    drainer = threading.Thread(target=drain, daemon=True)
+    drainer.start()
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and "addr" not in addr_box:
+            time.sleep(0.2)
+        addr = addr_box.get("addr", "")
         assert addr, "master never printed its address"
 
         for rank in (0, 1):
