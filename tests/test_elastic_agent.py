@@ -146,3 +146,36 @@ def test_network_check_single_node():
         client.close()
     finally:
         m.stop()
+
+
+def test_network_check_two_node_pair():
+    """The 2-node paired probe end-to-end: the NC rendezvous groups both
+    nodes into one pair, each spawns a probe subprocess that forms a
+    2-process jax.distributed set (via the master KV coordinator) and
+    runs the allgather diagnostic — the real ICI/DCN-probe path
+    (reference: training.py:681-874 + run_network_check.py:30-92)."""
+    import threading
+
+    from dlrover_tpu.diagnostics.network_check import run_network_check
+
+    m = JobMaster(min_nodes=2, max_nodes=2, host="127.0.0.1")
+    m.prepare()
+    try:
+        clients = [_client(m, rank) for rank in (0, 1)]
+        results = {}
+
+        def probe(rank):
+            results[rank] = run_network_check(
+                clients[rank], devices_per_node=1, timeout_s=180.0)
+
+        threads = [threading.Thread(target=probe, args=(rank,))
+                   for rank in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        assert results == {0: True, 1: True}
+        for c in clients:
+            c.close()
+    finally:
+        m.stop()
