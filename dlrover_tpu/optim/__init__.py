@@ -13,8 +13,13 @@ Capability parity: atorch/optim + atorch/optimizers —
 
 from dlrover_tpu.optim.agd import agd
 from dlrover_tpu.optim.bf16 import bf16_master
-from dlrover_tpu.optim.sparse import row_sparse_adagrad
+from dlrover_tpu.optim.sparse import (
+    row_sparse_adagrad,
+    row_sparse_adam,
+    row_sparse_sgd,
+)
 from dlrover_tpu.optim.wsam import wsam_value_and_grad
 
 __all__ = ["agd", "bf16_master", "row_sparse_adagrad",
+           "row_sparse_adam", "row_sparse_sgd",
            "wsam_value_and_grad"]

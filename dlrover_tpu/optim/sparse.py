@@ -57,3 +57,102 @@ def row_sparse_adagrad(
         return steps, RowSparseAdagradState(accumulator=accs)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _row_active_mask(g):
+    """(rows, 1, ...) bool mask of rows with any nonzero gradient."""
+    if g.ndim < 2:
+        return jnp.any(g != 0)
+    active = jnp.any(g.reshape(g.shape[0], -1) != 0, axis=-1)
+    return active.reshape((g.shape[0],) + (1,) * (g.ndim - 1))
+
+
+class RowSparseAdamState(NamedTuple):
+    mu: optax.Updates
+    nu: optax.Updates
+    counts: optax.Updates      # per-row step counts (bias correction)
+
+
+def row_sparse_adam(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    """Adam where only rows touched in the step update — moments AND the
+    per-row bias-correction counts of untouched rows stay bit-identical
+    (capability parity: atorch sparse adam; see module docstring)."""
+
+    def init_fn(params):
+        zeros = lambda p: jnp.zeros_like(p)
+        counts = jax.tree.map(
+            lambda p: jnp.zeros((p.shape[0],) + (1,) * (p.ndim - 1)
+                                if p.ndim >= 2 else (), jnp.int32),
+            params)
+        return RowSparseAdamState(
+            mu=jax.tree.map(zeros, params),
+            nu=jax.tree.map(zeros, params),
+            counts=counts)
+
+    def update_fn(updates, state, params=None):
+        del params
+
+        def one(g, mu, nu, count):
+            active = _row_active_mask(g)
+            new_count = jnp.where(active, count + 1, count)
+            new_mu = jnp.where(active, b1 * mu + (1 - b1) * g, mu)
+            new_nu = jnp.where(active, b2 * nu + (1 - b2) * jnp.square(g),
+                               nu)
+            t = jnp.maximum(new_count, 1).astype(jnp.float32)
+            mu_hat = new_mu / (1 - b1 ** t)
+            nu_hat = new_nu / (1 - b2 ** t)
+            step = jnp.where(
+                active,
+                -learning_rate * mu_hat / (jnp.sqrt(nu_hat) + eps),
+                jnp.zeros_like(g))
+            return step, new_mu, new_nu, new_count
+
+        is_arr = lambda x: isinstance(x, jnp.ndarray)
+        quads = jax.tree.map(one, updates, state.mu, state.nu,
+                             state.counts, is_leaf=is_arr)
+        pick = lambda i: jax.tree.map(
+            lambda q: q[i], quads, is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), RowSparseAdamState(mu=pick(1), nu=pick(2),
+                                           counts=pick(3))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class RowSparseSgdState(NamedTuple):
+    momentum: optax.Updates
+
+
+def row_sparse_sgd(
+    learning_rate: float = 0.01,
+    momentum: float = 0.9,
+) -> optax.GradientTransformation:
+    """SGD-with-momentum where untouched rows' buffers stay bit-identical
+    (capability parity: atorch sparse sgd)."""
+
+    def init_fn(params):
+        return RowSparseSgdState(
+            momentum=jax.tree.map(jnp.zeros_like, params))
+
+    def update_fn(updates, state, params=None):
+        del params
+
+        def one(g, buf):
+            active = _row_active_mask(g)
+            new_buf = jnp.where(active, momentum * buf + g, buf)
+            step = jnp.where(active, -learning_rate * new_buf,
+                             jnp.zeros_like(g))
+            return step, new_buf
+
+        is_arr = lambda x: isinstance(x, jnp.ndarray)
+        pairs = jax.tree.map(one, updates, state.momentum, is_leaf=is_arr)
+        is_tup = lambda x: isinstance(x, tuple)
+        return (jax.tree.map(lambda p: p[0], pairs, is_leaf=is_tup),
+                RowSparseSgdState(momentum=jax.tree.map(
+                    lambda p: p[1], pairs, is_leaf=is_tup)))
+
+    return optax.GradientTransformation(init_fn, update_fn)
