@@ -42,7 +42,13 @@ _NEG_INF = -1e30
 
 def _use_flash_blocks(block_impl: str) -> bool:
     """Per-device attention kernel dispatch shared by ring and Ulysses:
-    "auto" = flash kernel on TPU, einsum elsewhere."""
+    "auto" = flash kernel on TPU, einsum elsewhere.
+    $DLROVER_TPU_SP_BLOCK_IMPL overrides "auto" (tests force the flash
+    path through the model-level product dispatch in interpret mode)."""
+    import os
+
+    if block_impl == "auto":
+        block_impl = os.environ.get("DLROVER_TPU_SP_BLOCK_IMPL", "auto")
     return block_impl == "flash" or (
         block_impl == "auto" and jax.default_backend() == "tpu")
 

@@ -106,6 +106,22 @@ def test_sp_composes_with_tensor_parallel(
     np.testing.assert_allclose(losses, oracle_losses, rtol=2e-3)
 
 
+def test_sp_product_path_with_flash_blocks(cpu_devices_module,
+                                           oracle_losses, monkeypatch):
+    """The FULL product path (LlamaConfig.attn_impl="ring" through
+    auto_accelerate) with the ring-FLASH block kernel — what real TPU
+    runs execute — matches the oracle (interpret mode here)."""
+    monkeypatch.setenv("DLROVER_TPU_SP_BLOCK_IMPL", "flash")
+    result = _accelerate(
+        {}, [("sequence_parallel", {"size": 2}),
+             ("parallel_mode", {"data": 2})],
+        cpu_devices_module[:4],
+    )
+    tokens, targets = _data(LlamaConfig.tiny())
+    losses = _train_losses(result, tokens, targets)
+    np.testing.assert_allclose(losses, oracle_losses, rtol=2e-3)
+
+
 def test_ring_attn_impl_off_mesh_falls_back(cpu_devices_module):
     """attn_impl="ring" on a sequence=1 mesh must still train (falls back
     to plain attention instead of crashing)."""
