@@ -5,8 +5,9 @@ Capability parity: atorch AccelerationEngine + sg_algo
 sg_algo/{combination_sg,bo_sg,hebo}). TPU re-design: no worker-process
 gRPC fan-out — candidates are dry-run in-process (strategies change mesh/
 sharding, which jit handles in one process); the search is successive
-halving over the combination space (the BO/HEBO role: sample-efficient
-pruning) with deterministic tie-breaking toward smaller strategies.
+halving over small candidate spaces and Gaussian-process Bayesian
+optimization (sg_algo.bo_search) when the space outgrows the profiling
+budget, with deterministic tie-breaking toward smaller strategies.
 """
 
 from __future__ import annotations
@@ -16,9 +17,19 @@ from typing import List, Optional, Tuple
 
 from dlrover_tpu.auto.engine.dry_runner import dry_run
 from dlrover_tpu.auto.engine.planner import plan_candidates
+from dlrover_tpu.auto.engine.sg_algo import bo_search
 from dlrover_tpu.auto.model_context import ModelContext
 from dlrover_tpu.auto.strategy import Strategy
 from dlrover_tpu.common.log import default_logger as logger
+
+
+def _fallback_default(context: ModelContext) -> Strategy:
+    logger.warning(
+        "every candidate strategy failed to dry-run; falling back "
+        "to the default baseline")
+    from dlrover_tpu.auto.accelerate import default_strategy
+
+    return default_strategy(len(context.devices))
 
 
 def search_strategy(
@@ -26,14 +37,46 @@ def search_strategy(
     max_candidates: int = 0,
     rungs: Tuple[int, ...] = (1, 3),
     keep_fraction: float = 0.5,
+    algo: str = "auto",
+    budget: int = 0,
 ) -> Strategy:
-    """Successive halving: profile every candidate briefly (rungs[0]
-    steps), keep the top fraction, re-profile longer, repeat."""
+    """Pick the best strategy by profiling candidates.
+
+    algo: "sh" = successive halving (profile every candidate briefly,
+    keep the top fraction, re-profile longer); "bo" = GP Bayesian
+    optimization spending only `budget` dry-runs (sample-efficient for
+    large candidate spaces); "auto" = bo when the candidate list
+    outgrows the budget, else sh. Overridable via
+    DLROVER_TPU_SEARCH_ALGO.
+    """
     max_candidates = max_candidates or int(os.environ.get(
         "DLROVER_TPU_SEARCH_MAX_CANDIDATES", 8))
+    # explicit arguments win over the env knobs, uniformly
+    budget = max(1, budget or int(os.environ.get(
+        "DLROVER_TPU_SEARCH_BUDGET") or 6))
+    if algo == "auto":
+        algo = os.environ.get("DLROVER_TPU_SEARCH_ALGO", "auto")
+    algo = algo.strip().lower()
+    if algo not in ("auto", "bo", "sh"):
+        logger.warning("unknown search algo %r; using successive halving",
+                       algo)
+        algo = "sh"
     candidates = plan_candidates(context, max_candidates=max_candidates)
     if not candidates:
         return []
+    if algo == "auto":
+        algo = "bo" if len(candidates) > budget else "sh"
+    if algo == "bo":
+        best, best_speed, history = bo_search(
+            candidates,
+            lambda c: dry_run(context, c, warmup=1, steps=rungs[-1])[0],
+            budget=budget)
+        if best is None:
+            return _fallback_default(context)
+        logger.info("bo search picked %s (%.2f steps/s, %d/%d profiled)",
+                    [name for name, _ in best], best_speed,
+                    len(history), len(candidates))
+        return best
     scored: List[Tuple[float, int, Strategy]] = [
         (0.0, i, c) for i, c in enumerate(candidates)]
     for steps in rungs:
@@ -46,12 +89,7 @@ def search_strategy(
                 continue  # failed candidates never advance a rung
             results.append((speed, i, candidate))
         if not results:
-            logger.warning(
-                "every candidate strategy failed to dry-run; falling back "
-                "to the default baseline")
-            from dlrover_tpu.auto.accelerate import default_strategy
-
-            return default_strategy(len(context.devices))
+            return _fallback_default(context)
         results.sort(key=lambda t: (-t[0], len(t[2])))
         keep = max(1, int(len(results) * keep_fraction))
         scored = results[:keep]

@@ -49,6 +49,11 @@ def _use_flash_blocks(block_impl: str) -> bool:
 
     if block_impl == "auto":
         block_impl = os.environ.get("DLROVER_TPU_SP_BLOCK_IMPL", "auto")
+    block_impl = block_impl.strip().lower()
+    if block_impl not in ("auto", "flash", "einsum"):
+        raise ValueError(
+            f"unknown SP block impl {block_impl!r}: "
+            "expected auto | flash | einsum")
     return block_impl == "flash" or (
         block_impl == "auto" and jax.default_backend() == "tpu")
 

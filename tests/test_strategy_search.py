@@ -1,0 +1,205 @@
+"""Sample-efficient strategy search (sg_algo): GP surrogate + BO loop.
+
+Reference role: atorch/auto/engine/sg_algo/{bo_sg.py,hebo/} — Bayesian
+optimization proposing strategy combinations scored by dry-runs. These
+tests exercise the surrogate and the search loop against synthetic
+objectives (no JAX lowering), then the `search_strategy(algo="bo")`
+integration against a monkeypatched dry-run.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.auto.engine.sg_algo import (
+    GaussianProcess,
+    bo_search,
+    expected_improvement,
+    featurize,
+)
+
+
+def strat(*names, fsdp=0, tensor=0):
+    s = [(n, {}) for n in names]
+    if fsdp:
+        s.append(("fsdp", {"size": fsdp}))
+    if tensor:
+        s.append(("tensor_parallel", {"size": tensor}))
+    return s
+
+
+class TestFeaturize:
+    def test_distinct_strategies_distinct_vectors(self):
+        a = featurize(strat("half", fsdp=4))
+        b = featurize(strat("half", fsdp=8))
+        c = featurize(strat("half", "checkpoint", fsdp=4))
+        assert not np.allclose(a, b)
+        assert not np.allclose(a, c)
+
+    def test_axis_sizes_enter_log2(self):
+        a = featurize(strat(fsdp=8))
+        b = featurize(strat(fsdp=2))
+        assert a[-2] == pytest.approx(3.0)
+        assert b[-2] == pytest.approx(1.0)
+        t = featurize(strat(tensor=4))
+        assert t[-1] == pytest.approx(2.0)
+
+    def test_unknown_pass_hits_overflow_slot(self):
+        x = featurize([("made_up_pass", {})])
+        assert x.sum() == pytest.approx(1.0)
+
+
+class TestGaussianProcess:
+    def test_interpolates_observations(self):
+        x = np.array([[0.0], [1.0], [2.0], [3.0]])
+        y = np.array([0.0, 1.0, 4.0, 9.0])
+        gp = GaussianProcess().fit(x, y)
+        mean, std = gp.predict(x)
+        np.testing.assert_allclose(mean, y, atol=0.1)
+        assert (std < 0.2).all()
+
+    def test_uncertainty_grows_away_from_data(self):
+        x = np.array([[0.0], [1.0]])
+        gp = GaussianProcess().fit(x, np.array([0.0, 1.0]))
+        _, std_near = gp.predict(np.array([[0.5]]))
+        _, std_far = gp.predict(np.array([[10.0]]))
+        assert std_far[0] > std_near[0]
+
+    def test_ei_prefers_promising_unexplored(self):
+        # observations rise toward x=2; EI at the frontier beats EI at
+        # an already-observed point
+        x = np.array([[0.0], [1.0], [2.0]])
+        y = np.array([0.0, 1.0, 2.0])
+        gp = GaussianProcess().fit(x, y)
+        mean, std = gp.predict(np.array([[2.5], [0.0]]))
+        ei = expected_improvement(mean, std, best=2.0)
+        assert ei[0] > ei[1]
+
+
+class TestBoSearch:
+    def make_space(self):
+        """16 candidates; the objective secretly rewards checkpoint +
+        fsdp size 4 and punishes tensor parallelism."""
+        candidates = []
+        for fsdp in (0, 2, 4, 8):
+            for tensor in (0, 2):
+                for ckpt in (False, True):
+                    names = ["half"] + (["checkpoint"] if ckpt else [])
+                    candidates.append(strat(*names, fsdp=fsdp,
+                                            tensor=tensor))
+
+        def score(c):
+            d = dict(c)
+            v = 10.0
+            fsdp_size = d.get("fsdp", {}).get("size", 1)
+            v -= abs(math.log2(fsdp_size) - 2)
+            if "tensor_parallel" in d:
+                v -= 2.0
+            if "checkpoint" in d:
+                v += 1.5
+            return v
+
+        best = max(candidates, key=score)
+        return candidates, score, best
+
+    def test_finds_optimum_with_partial_budget(self):
+        candidates, score, best = self.make_space()
+        calls = []
+
+        def evaluate(c):
+            calls.append(c)
+            return score(c)
+
+        found, found_score, history = bo_search(
+            candidates, evaluate, budget=10)
+        assert len(calls) == 10 < len(candidates)
+        assert found_score == pytest.approx(score(best))
+
+    def test_failures_are_modeled_not_fatal(self):
+        candidates, score, _ = self.make_space()
+
+        def evaluate(c):
+            if dict(c).get("tensor_parallel"):  # half the space fails
+                return float("-inf")
+            return score(c)
+
+        found, found_score, _ = bo_search(candidates, evaluate, budget=8)
+        assert found is not None
+        assert math.isfinite(found_score)
+        assert not dict(found).get("tensor_parallel")
+
+    def test_all_failures_returns_none(self):
+        candidates, _, _ = self.make_space()
+        found, found_score, history = bo_search(
+            candidates, lambda c: float("-inf"), budget=4)
+        assert found is None
+        assert found_score == float("-inf")
+        assert len(history) == 4
+
+    def test_budget_clamped_to_space(self):
+        candidates, score, best = self.make_space()
+        found, found_score, history = bo_search(
+            candidates, score, budget=1000)
+        assert len(history) == len(candidates)
+        assert found_score == pytest.approx(score(best))
+
+
+class TestSearchStrategyBo:
+    def test_bo_algo_profiles_fewer_than_candidates(self, monkeypatch):
+        from dlrover_tpu.auto import model_context
+        from dlrover_tpu.auto.engine import acceleration_engine as eng
+
+        candidates, score, best = TestBoSearch().make_space()
+        monkeypatch.setattr(
+            eng, "plan_candidates", lambda ctx, max_candidates=16:
+            candidates)
+        calls = []
+
+        def fake_dry_run(ctx, c, warmup=1, steps=3):
+            calls.append(c)
+            return score(c), ""
+
+        monkeypatch.setattr(eng, "dry_run", fake_dry_run)
+        ctx = object.__new__(model_context.ModelContext)
+        picked = eng.search_strategy(ctx, algo="bo", budget=10)
+        assert len(calls) == 10
+        assert score(picked) == pytest.approx(score(best))
+
+    def test_auto_picks_bo_for_large_space(self, monkeypatch):
+        from dlrover_tpu.auto import model_context
+        from dlrover_tpu.auto.engine import acceleration_engine as eng
+
+        candidates, score, _ = TestBoSearch().make_space()
+        monkeypatch.setattr(
+            eng, "plan_candidates", lambda ctx, max_candidates=16:
+            candidates)
+        calls = []
+
+        def fake_dry_run(ctx, c, warmup=1, steps=3):
+            calls.append(c)
+            return score(c), ""
+
+        monkeypatch.setattr(eng, "dry_run", fake_dry_run)
+        ctx = object.__new__(model_context.ModelContext)
+        eng.search_strategy(ctx, algo="auto", budget=6)
+        assert len(calls) == 6  # bo path: budget-bounded
+
+    def test_bo_all_fail_falls_back_to_default(self, monkeypatch):
+        from dlrover_tpu.auto import model_context
+        from dlrover_tpu.auto.engine import acceleration_engine as eng
+
+        candidates, _, _ = TestBoSearch().make_space()
+        monkeypatch.setattr(
+            eng, "plan_candidates", lambda ctx, max_candidates=16:
+            candidates)
+        monkeypatch.setattr(
+            eng, "dry_run",
+            lambda ctx, c, warmup=1, steps=3: (float("-inf"), "boom"))
+        ctx = object.__new__(model_context.ModelContext)
+        ctx.devices = [object()] * 4
+        picked = eng.search_strategy(ctx, algo="bo", budget=4)
+
+        from dlrover_tpu.auto.accelerate import default_strategy
+
+        assert picked == default_strategy(4)
