@@ -75,6 +75,11 @@ class LlamaConfig:
                    num_heads=16, num_kv_heads=16, **kw)
 
     @classmethod
+    def llama_7b(cls, **kw) -> "LlamaConfig":
+        return cls(hidden_size=4096, intermediate_size=11008,
+                   num_layers=32, num_heads=32, num_kv_heads=32, **kw)
+
+    @classmethod
     def llama_410m(cls, **kw) -> "LlamaConfig":
         return cls(hidden_size=1024, intermediate_size=2816, num_layers=24,
                    num_heads=8, num_kv_heads=8, **kw)

@@ -88,6 +88,20 @@ def load_native() -> Optional[ctypes.CDLL]:
         lib.shm_ring_close.argtypes = [ctypes.c_void_p]
         # -- reconciler signatures --
         lib.reconciler_abi_version.restype = ctypes.c_int32
+        # -- host custom ops (ops/host_ops.py); a stale .so may predate
+        # them, and the shm/reconciler consumers must keep working then
+        # (host_ops falls back to numpy via its own hasattr guard) --
+        if hasattr(lib, "dlrover_tpu_crc32"):
+            lib.dlrover_tpu_crc32.restype = ctypes.c_uint32
+            lib.dlrover_tpu_crc32.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+                ctypes.c_uint32]
+        if hasattr(lib, "dlrover_tpu_token_histogram"):
+            lib.dlrover_tpu_token_histogram.restype = ctypes.c_uint64
+            lib.dlrover_tpu_token_histogram.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint32,
+                ctypes.c_int]
         _lib = lib
         return _lib
 

@@ -1,0 +1,80 @@
+"""Host-side native custom ops + the jit integration pattern.
+
+Capability parity: tfplus's custom-op extension point (the reference's
+`tfplus/tfplus/cc/demo.{h,cc}` skeleton + its Bazel/setup.py build —
+tfplus/setup.py:155). TPU re-design: device custom ops are Pallas kernels
+(ops/flash_attention.py, ops/quantization.py); HOST custom ops are
+C-linkage functions in native/custom_op.cpp loaded via ctypes, and
+`checksum_in_jit` shows the sanctioned way to call one from inside a jit
+program (jax.pure_callback with a declared abstract result — XLA treats it
+as an opaque host call; do NOT put these on the hot path, they force a
+device→host sync).
+
+Both ops degrade to numpy when the native toolchain is unavailable, so the
+data plane never hard-depends on g++ at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import zlib
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from dlrover_tpu.native_build import load_native
+
+
+def _as_bytes_view(data) -> np.ndarray:
+    arr = np.ascontiguousarray(data)
+    return arr.view(np.uint8).reshape(-1)
+
+
+def crc32(data, seed: int = 0) -> int:
+    """zlib-compatible CRC32 of an array/bytes; chain via `seed`."""
+    view = _as_bytes_view(np.frombuffer(data, np.uint8)
+                          if isinstance(data, (bytes, bytearray))
+                          else data)
+    lib = load_native()
+    if lib is None or not hasattr(lib, "dlrover_tpu_crc32"):
+        return zlib.crc32(view.tobytes(), seed) & 0xFFFFFFFF
+    ptr = view.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    return int(lib.dlrover_tpu_crc32(ptr, view.size, seed & 0xFFFFFFFF))
+
+
+def token_histogram(tokens, vocab_size: int,
+                    count_oov: bool = True) -> Tuple[np.ndarray, int]:
+    """Counts of each token id; returns (hist, n_out_of_vocab).
+
+    hist has vocab_size+1 slots when count_oov (last slot = OOV bucket),
+    else vocab_size. Used by the data plane for input-skew diagnostics.
+    """
+    toks = np.ascontiguousarray(tokens, dtype=np.int32).reshape(-1)
+    slots = vocab_size + (1 if count_oov else 0)
+    hist = np.zeros(slots, dtype=np.uint64)
+    lib = load_native()
+    if lib is None or not hasattr(lib, "dlrover_tpu_token_histogram"):
+        in_vocab = toks[(toks >= 0) & (toks < vocab_size)]
+        hist[:vocab_size] += np.bincount(
+            in_vocab, minlength=vocab_size).astype(np.uint64)
+        oov = toks.size - in_vocab.size
+        if count_oov:
+            hist[vocab_size] += np.uint64(oov)
+        return hist, int(oov)
+    oov = lib.dlrover_tpu_token_histogram(
+        toks.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), toks.size,
+        hist.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), vocab_size,
+        1 if count_oov else 0)
+    return hist, int(oov)
+
+
+def checksum_in_jit(x: jax.Array) -> jax.Array:
+    """CRC32 of a device array from INSIDE a jit program — the extension-
+    point demo: jax.pure_callback bridges a traced value to the native op
+    and back as a declared uint32 scalar."""
+    def _host(arr) -> np.ndarray:
+        return np.uint32(crc32(np.asarray(arr)))
+
+    return jax.pure_callback(
+        _host, jax.ShapeDtypeStruct((), np.uint32), x, vmap_method="sequential")

@@ -56,13 +56,19 @@ def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dwp_ref,
     dwp_ref[:] += jnp.broadcast_to(partial, dwp_ref.shape)
 
 
-def _rows_block(n_rows: int) -> int:
-    """Largest divisor of n_rows <= 256: Pallas pads out-of-bounds rows
-    with undefined data on real TPU, and the backward's dw accumulation
-    would silently fold that garbage into the weight gradient."""
+def _rows_block(n_rows: int, dim: int, bytes_per_elem: int) -> int:
+    """Row-block size: a divisor of n_rows (Pallas pads out-of-bounds
+    rows with undefined data on real TPU, and the backward's dw
+    accumulation would silently fold that garbage into the weight
+    gradient), capped so the block's fp32 working set fits scoped VMEM.
+    bytes_per_elem estimates the live per-element footprint — ~12 B for
+    the forward (x, out, fp32 copy), ~32 B for the backward (x, g, dx,
+    xhat, wg and products); 10 MB of the 16 MB scoped limit leaves
+    headroom for the weight row and rstd column."""
     from dlrover_tpu.ops.flash_attention import fit_block
 
-    return fit_block(n_rows, 256)
+    cap = max(8, (10 * 1024 * 1024) // (dim * bytes_per_elem))
+    return fit_block(n_rows, min(256, cap))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -81,7 +87,7 @@ def _rms_fwd(x, weight, eps):
     dim = orig_shape[-1]
     x2 = x.reshape(-1, dim)
     rows = x2.shape[0]
-    block = _rows_block(rows)
+    block = _rows_block(rows, dim, bytes_per_elem=12)
     grid = ((rows + block - 1) // block,)
     out, rstd = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps),
@@ -113,7 +119,7 @@ def _rms_bwd_vjp(eps, res, g):
     dim = x2.shape[1]
     rows = x2.shape[0]
     g2 = g.reshape(-1, dim)
-    block = _rows_block(rows)
+    block = _rows_block(rows, dim, bytes_per_elem=32)
     n_blocks = (rows + block - 1) // block
     dx, dw_partial = pl.pallas_call(
         functools.partial(_rms_bwd_kernel, eps=eps),

@@ -63,6 +63,26 @@ def mesh_shardings(tree: Any, mesh: Mesh,
     return nn.logical_to_mesh_sharding(logical_specs, mesh, rules)
 
 
+def sanitize_shardings(shardings: Any, abstract: Any, mesh: Mesh) -> Any:
+    """Replace shardings that cannot apply to their leaf's rank.
+
+    Optimizer transformations can carry a param's logical axis names onto
+    state leaves of DIFFERENT rank — e.g. adafactor's factored second
+    moments are rank-1 reductions of rank-2 params, so the inherited
+    2-axis spec is invalid for them. Any NamedSharding with more
+    partitioned dims than the leaf has axes falls back to replicated
+    (factored/statistic leaves are small; replication is the right call).
+    `abstract` must be the UNBOXED abstract tree matching `shardings`.
+    """
+    def fix(s, a):
+        if (isinstance(s, NamedSharding)
+                and len(s.spec) > getattr(a, "ndim", 0)):
+            return NamedSharding(mesh, P(), memory_kind=s.memory_kind)
+        return s
+
+    return jax.tree.map(fix, shardings, abstract)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Global-batch arrays sharded over (data, fsdp)."""
     return NamedSharding(mesh, P((MeshAxis.DATA, MeshAxis.FSDP)))

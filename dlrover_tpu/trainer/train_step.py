@@ -23,6 +23,7 @@ from dlrover_tpu.common.constants import MeshAxis
 from dlrover_tpu.parallel.sharding import (
     DEFAULT_RULES,
     mesh_shardings,
+    sanitize_shardings,
 )
 
 
@@ -124,6 +125,10 @@ def build_trainer(
             _init_boxed, jax.random.key(0)
         )
     state_shardings = mesh_shardings(abstract_boxed, mesh, rules)
+    # factored optimizers (adafactor) produce state leaves whose rank
+    # differs from the param that named their axes — replicate those
+    state_shardings = sanitize_shardings(
+        state_shardings, nn.unbox(abstract_boxed), mesh)
     if offload_opt_state:
         abstract_opt = nn.unbox(abstract_boxed).opt_state
         state_shardings = state_shardings.replace(

@@ -1,0 +1,69 @@
+// Custom host-op extension point (tfplus-equivalent).
+//
+// Capability parity: tfplus's demo custom op (tfplus/tfplus/cc/demo.{h,cc}
+// — the reference's skeleton showing where users bolt native C++ ops onto
+// the framework). TPU re-design: device-side custom ops are Pallas kernels
+// (ops/flash_attention.py, ops/quantization.py); HOST-side native ops are
+// plain C-linkage functions in this library, surfaced to Python via ctypes
+// (dlrover_tpu/ops/host_ops.py) and into jit programs via
+// jax.pure_callback. The two ops here are real, not placeholders: a
+// zlib-compatible CRC32 for batch-integrity checks on the data plane, and
+// a token histogram for input-skew diagnostics.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// zlib CRC-32 (reflected, poly 0xEDB88320), table generated on first use.
+const uint32_t* crc_table() {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  return table;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Matches zlib.crc32(data, seed): callers chain batches by feeding the
+// previous result back as seed.
+uint32_t dlrover_tpu_crc32(const uint8_t* data, uint64_t n, uint32_t seed) {
+  const uint32_t* table = crc_table();
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (uint64_t i = 0; i < n; ++i)
+    c = table[(c ^ data[i]) & 0xFFu] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// Counts token ids into out[vocab] (uint64, caller-zeroed or not —
+// counts are ADDED so shards can accumulate). Ids outside [0, vocab)
+// are counted into out[vocab] when out has vocab+1 slots per the
+// `clamp_oov` flag; with clamp_oov=0 they are skipped. Returns the
+// number of out-of-vocab tokens seen.
+uint64_t dlrover_tpu_token_histogram(const int32_t* tokens, uint64_t n,
+                                     uint64_t* out, uint32_t vocab,
+                                     int clamp_oov) {
+  uint64_t oov = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    int32_t t = tokens[i];
+    if (t >= 0 && static_cast<uint32_t>(t) < vocab) {
+      ++out[t];
+    } else {
+      ++oov;
+      if (clamp_oov) ++out[vocab];
+    }
+  }
+  return oov;
+}
+
+}  // extern "C"

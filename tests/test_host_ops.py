@@ -1,0 +1,79 @@
+"""Host custom ops (the tfplus-equivalent extension point).
+
+Oracle for the native CRC32 is zlib (same polynomial by construction);
+oracle for the histogram is numpy bincount. `checksum_in_jit` proves the
+pure_callback bridge works under jit, including on multi-device CPU.
+"""
+
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.native_build import load_native
+from dlrover_tpu.ops.host_ops import checksum_in_jit, crc32, token_histogram
+
+
+class TestCrc32:
+    def test_matches_zlib_on_bytes(self):
+        data = b"dlrover-tpu native extension point"
+        assert crc32(data) == zlib.crc32(data) & 0xFFFFFFFF
+
+    def test_matches_zlib_on_arrays(self):
+        arr = np.arange(1000, dtype=np.float32)
+        assert crc32(arr) == zlib.crc32(arr.tobytes()) & 0xFFFFFFFF
+
+    def test_seed_chaining(self):
+        a, b = b"first half|", b"second half"
+        chained = crc32(b, seed=crc32(a))
+        assert chained == zlib.crc32(a + b) & 0xFFFFFFFF
+
+    def test_native_lib_provides_symbol(self):
+        lib = load_native()
+        if lib is None:
+            pytest.skip("native toolchain unavailable")
+        assert hasattr(lib, "dlrover_tpu_crc32")
+
+
+class TestTokenHistogram:
+    def test_matches_bincount(self):
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 50, 10_000).astype(np.int32)
+        hist, oov = token_histogram(toks, vocab_size=50)
+        np.testing.assert_array_equal(
+            hist[:50], np.bincount(toks, minlength=50))
+        assert oov == 0
+        assert hist[50] == 0  # OOV bucket empty
+
+    def test_oov_bucket(self):
+        toks = np.array([0, 1, 99, -5, 2], np.int32)
+        hist, oov = token_histogram(toks, vocab_size=3)
+        assert oov == 2
+        assert hist[3] == 2
+        np.testing.assert_array_equal(hist[:3], [1, 1, 1])
+
+    def test_no_oov_bucket_when_disabled(self):
+        toks = np.array([0, 99], np.int32)
+        hist, oov = token_histogram(toks, vocab_size=3, count_oov=False)
+        assert hist.shape == (3,)
+        assert oov == 1
+
+
+class TestChecksumInJit:
+    def test_under_jit_matches_host(self):
+        x = jnp.arange(256, dtype=jnp.float32)
+
+        @jax.jit
+        def f(v):
+            return checksum_in_jit(v * 2.0)
+
+        expected = crc32(np.asarray(x) * 2.0)
+        assert int(f(x)) == expected
+
+    def test_detects_corruption(self):
+        x = jnp.arange(64, dtype=jnp.float32)
+        a = int(jax.jit(checksum_in_jit)(x))
+        b = int(jax.jit(checksum_in_jit)(x.at[7].set(1e9)))
+        assert a != b

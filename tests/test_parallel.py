@@ -117,6 +117,37 @@ class TestShardedTraining:
         assert (mu_embed.sharding.shard_shape(mu_embed.shape)
                 == shard_shape)
 
+    def test_factored_optimizer_state_on_sharded_mesh(self, cpu_devices):
+        """adafactor's factored second moments are rank-1 reductions of
+        rank-2 params; the inherited 2-axis specs are invalid for them and
+        must fall back to replicated (sanitize_shardings) while params
+        stay sharded. Regression: this used to fail trainer init with
+        'sharding is only valid for values of rank at least 2'."""
+        cfg = LlamaConfig.tiny(attn_impl="reference", dtype=jnp.float32)
+        mesh = create_mesh(MeshSpec(fsdp=2, tensor=2), cpu_devices[:4])
+        trainer = build_trainer(
+            Llama(cfg), optax.adafactor(1e-3), mesh,
+            jnp.zeros((8, 16), jnp.int32), cross_entropy_loss,
+            accum_steps=1, micro_batch=8)
+        state = trainer.init(jax.random.PRNGKey(0))
+        embed = state.params["embed"]
+        assert (embed.sharding.shard_shape(embed.shape)[1]
+                == embed.shape[1] // 2)
+        factored = [
+            leaf for leaf in jax.tree.leaves(state.opt_state)
+            if getattr(leaf, "ndim", 0) == 1 and leaf.shape[0] > 1
+        ]
+        assert factored, "expected rank-1 factored moments in the state"
+        rng = jax.random.PRNGKey(1)
+        tokens = np.asarray(jax.random.randint(rng, (8, 16), 0,
+                                               cfg.vocab_size))
+        losses = []
+        for _ in range(3):
+            tok, tgt = trainer.shard_batch(tokens, tokens)
+            state, metrics = trainer.step(state, tok, tgt)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+
     def test_grad_accum_matches_large_batch(self, cpu_devices):
         mesh = create_mesh(MeshSpec(data=2), cpu_devices[:2])
         trainer_big, tokens, targets = _setup(mesh, accum=1, micro=8)
