@@ -118,16 +118,21 @@ def size_axes(info: Dict[str, Any]) -> Dict[str, Any]:
        sized to the smallest divisor of the remaining devices that
        divides BOTH num_heads and num_kv_heads (Megatron head-split
        constraint) and makes the width-sharded activations fit.
-    4. data: whatever devices remain.
+    4. sequence: the long-context escape hatch — when activations
+       still don't fit after remat AND tensor (the sequence is so long
+       that even a single layer's width-sharded activations blow the
+       budget), shard the sequence dim over remaining devices (ring
+       attention keeps the math exact).
+    5. data: whatever devices remain.
 
-    Returns {"fsdp", "tensor", "data", "remat"}; all 1/False when the
-    device HBM is unknown (nothing to size against).
+    Returns {"fsdp", "tensor", "sequence", "data", "remat"}; all
+    1/False when the device HBM is unknown (nothing to size against).
     """
     n_devices = info["n_devices"]
     hbm = info["device_hbm_bytes"]
     if not hbm or n_devices < 1:
-        return {"fsdp": 1, "tensor": 1, "data": n_devices or 1,
-                "remat": False}
+        return {"fsdp": 1, "tensor": 1, "sequence": 1,
+                "data": n_devices or 1, "remat": False}
     state_budget = hbm * STATE_HBM_FRACTION
     state = info["train_state_bytes"]
 
@@ -152,6 +157,15 @@ def size_axes(info: Dict[str, Any]) -> Dict[str, Any]:
                 if act_eff / d <= act_budget:
                     break
 
-    data = n_devices // (fsdp * tensor)
-    return {"fsdp": fsdp, "tensor": tensor, "data": max(1, data),
-            "remat": remat}
+    sequence = 1
+    seq_len = info.get("seq_len", 0)
+    if act_eff / tensor > act_budget and seq_len:
+        for d in _divisors_of(n_devices // (fsdp * tensor)):
+            if d > 1 and seq_len % d == 0:
+                sequence = d
+                if act_eff / (tensor * d) <= act_budget:
+                    break
+
+    data = n_devices // (fsdp * tensor * sequence)
+    return {"fsdp": fsdp, "tensor": tensor, "sequence": sequence,
+            "data": max(1, data), "remat": remat}

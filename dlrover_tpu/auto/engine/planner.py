@@ -21,29 +21,36 @@ from dlrover_tpu.auto.strategy import Strategy
 def _sized_candidates(info, n_devices: int) -> List[Strategy]:
     """Model-aware sized strategies, best-guess first plus neighbors."""
     sizing = size_axes(info)
+    # (sequence > 1 implies remat per size_axes's ordering, so these two
+    # conditions also cover the long-context case)
     if sizing["fsdp"] <= 1 and not sizing["remat"]:
         return []
 
-    def build(fsdp: int, tensor: int, remat: bool) -> Strategy:
+    def build(fsdp: int, tensor: int, remat: bool,
+              sequence: int = 1) -> Strategy:
         strategy: Strategy = [("half", {}), ("module_replace", {})]
         if fsdp > 1:
             strategy.append(("fsdp", {"size": fsdp}))
         if tensor > 1:
             strategy.append(("tensor_parallel", {"size": tensor}))
+        if sequence > 1:
+            strategy.append(("sequence_parallel", {"size": sequence}))
         if remat:
             strategy.append(("checkpoint", {}))
         return strategy
 
-    candidates = [build(sizing["fsdp"], sizing["tensor"], sizing["remat"])]
+    candidates = [build(sizing["fsdp"], sizing["tensor"], sizing["remat"],
+                        sizing["sequence"])]
     # neighbors: one rung more sharding (cheaper HBM, more comm) and the
     # remat flip, so the dry-run can catch a mis-estimate
     more_fsdp = sizing["fsdp"] * 2
-    if more_fsdp * sizing["tensor"] <= n_devices and (
-            n_devices % (more_fsdp * sizing["tensor"]) == 0):
+    if more_fsdp * sizing["tensor"] * sizing["sequence"] <= n_devices and (
+            n_devices % (more_fsdp * sizing["tensor"]
+                         * sizing["sequence"]) == 0):
         candidates.append(build(more_fsdp, sizing["tensor"],
-                                sizing["remat"]))
+                                sizing["remat"], sizing["sequence"]))
     candidates.append(build(sizing["fsdp"], sizing["tensor"],
-                            not sizing["remat"]))
+                            not sizing["remat"], sizing["sequence"]))
     return candidates
 
 

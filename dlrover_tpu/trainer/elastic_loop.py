@@ -218,6 +218,10 @@ class ElasticTrainLoop:
             if config.max_steps and step - start_step >= config.max_steps:
                 break
         metrics = {k: float(v) for k, v in raw_metrics.items()}
+        # the step actually REACHED (an early stop — SIGTERM, exhausted
+        # data — ends below start_step + max_steps; callers must not
+        # assume the request was met)
+        metrics["step"] = float(step)
         if self.checkpointer is not None:
             self.checkpointer.wait()
         return state, metrics

@@ -132,7 +132,7 @@ def main(argv=None) -> int:
     loop.config.max_steps = args.steps - start_step
     state, metrics = loop.run(state, data, start_step=start_step,
                               sampler=sampler)
-    final_step = start_step + loop.config.max_steps
+    final_step = int(metrics.get("step", start_step))
     log(f"nanogpt: done step={final_step} loss={metrics.get('loss', -1):.4f}")
     loop.close()
     return 0

@@ -200,8 +200,8 @@ class TestEngine:
                 "num_heads": 16, "num_kv_heads": 16}
         sizing = size_axes(info)
         # 36/2=18 > 9.6, 36/4=9 <= 9.6 -> fsdp 4, data absorbs the rest
-        assert sizing == {"fsdp": 4, "tensor": 1, "data": 2,
-                          "remat": False}
+        assert sizing == {"fsdp": 4, "tensor": 1, "sequence": 1,
+                          "data": 2, "remat": False}
 
     def test_size_axes_remat_and_tensor_from_activations(self):
         from dlrover_tpu.auto.engine.analyser import size_axes
@@ -220,12 +220,33 @@ class TestEngine:
         assert sizing["tensor"] == 2
         assert sizing["data"] == 4
 
+    def test_size_axes_sequence_for_long_context(self):
+        """When activations blow the budget even after remat AND the
+        head-divisibility-capped tensor split, the sequence axis takes
+        the rest (ring attention keeps the math exact) — the
+        long-context escape hatch."""
+        from dlrover_tpu.auto.engine.analyser import size_axes
+
+        gib = 1 << 30
+        info = {"n_devices": 8, "device_hbm_bytes": 16 * gib,
+                "train_state_bytes": 9 * gib,
+                "activation_bytes": 1600 * gib,   # seq 256k-class
+                "num_heads": 4, "num_kv_heads": 2, "seq_len": 1 << 18}
+        sizing = size_axes(info)
+        assert sizing["remat"] is True
+        assert sizing["tensor"] == 2          # capped by kv heads
+        # act_eff ≈ 228 GiB; /tensor 2 = 114 > 5.6 GiB budget -> the
+        # remaining 4 devices go to sequence
+        assert sizing["sequence"] == 4
+        assert sizing["data"] == 1
+
     def test_size_axes_unknown_hbm_is_noop(self):
         from dlrover_tpu.auto.engine.analyser import size_axes
 
         assert size_axes({"n_devices": 8, "device_hbm_bytes": 0,
                           "train_state_bytes": 1}) == {
-            "fsdp": 1, "tensor": 1, "data": 8, "remat": False}
+            "fsdp": 1, "tensor": 1, "sequence": 1, "data": 8,
+            "remat": False}
 
     def test_auto_picks_sized_fsdp_strategy(self, monkeypatch,
                                             cpu_devices):

@@ -1,6 +1,6 @@
-"""examples/nanogpt through the REAL CLI stack: master + agent + worker
-subprocesses, with checkpoint-resume (reference parity: the shell system
-tests that run the stack outside pytest,
+"""examples/ (nanogpt, longcontext) through the REAL CLI stack: master +
+agent + worker subprocesses, with checkpoint-resume (reference parity:
+the shell system tests that run the stack outside pytest,
 examples/tensorflow/criteo_deeprec/run.sh:15-18)."""
 
 import os
@@ -11,15 +11,16 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRAIN = os.path.join(REPO, "examples", "nanogpt", "train.py")
+TRAIN_LONGCTX = os.path.join(REPO, "examples", "longcontext", "train.py")
 
 
-def run_cli(tmp_path, extra, timeout=240):
+def run_cli(tmp_path, extra, timeout=240, script=TRAIN):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.run(
         [sys.executable, "-m", "dlrover_tpu.run", "--standalone",
          "--devices-per-node", "1", "--monitor-interval", "0.2",
-         TRAIN] + extra,
+         script] + extra,
         env=env, capture_output=True, text=True, timeout=timeout,
         cwd=REPO,
     )
@@ -113,3 +114,34 @@ def test_nanogpt_worker_kill_restarts_and_resumes(tmp_path):
         except OSError:
             pass
         proc.wait(timeout=30)
+
+
+def test_longcontext_ring_attention_standalone(tmp_path):
+    """The long-context example through the real CLI: ring attention on
+    a sequence-sharded mesh (4 virtual CPU devices), checkpoint commit,
+    then a resumed run continuing from the saved step."""
+    ckpt = str(tmp_path / "ckpt")
+    log1 = str(tmp_path / "run1.log")
+    proc = run_cli(tmp_path, [
+        "--steps", "4", "--save-interval", "2",
+        "--global-batch", "2", "--seq", "256", "--seq-shards", "4",
+        "--hidden", "128", "--layers", "2",
+        "--ckpt-dir", ckpt, "--log-file", log1,
+    ], script=TRAIN_LONGCTX, timeout=360)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = open(log1).read()
+    assert "start_step=0" in lines and "seq_shards=4" in lines
+    assert "done step=4" in lines
+    assert os.path.isdir(ckpt) and os.listdir(ckpt)
+
+    log2 = str(tmp_path / "run2.log")
+    proc = run_cli(tmp_path, [
+        "--steps", "6", "--save-interval", "2",
+        "--global-batch", "2", "--seq", "256", "--seq-shards", "4",
+        "--hidden", "128", "--layers", "2",
+        "--ckpt-dir", ckpt, "--log-file", log2,
+    ], script=TRAIN_LONGCTX, timeout=360)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = open(log2).read()
+    assert "start_step=4" in lines
+    assert "done step=6" in lines
