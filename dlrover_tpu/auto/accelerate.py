@@ -120,12 +120,18 @@ def lower(context: ModelContext) -> AccelerateResult:
         if plan.remat_policy:
             updates["remat_policy"] = plan.remat_policy
     if updates:
-        if not context.replace_model_config(**updates):
+        skipped = context.replace_model_config(**updates)
+        if skipped is None:
             logger.info(
-                "model has no dataclass cfg accepting %s; dtype/kernel "
-                "edits skipped (strategy still shapes mesh + shardings)",
-                sorted(updates),
-            )
+                "model has no dataclass cfg; edits %s skipped (strategy "
+                "still shapes mesh + shardings)", sorted(updates))
+        elif skipped:
+            # a partially-supported config is a memory-plan hazard: the
+            # sizing may have counted on the dropped edit (remat, SP)
+            logger.warning(
+                "model config does not accept %s; those edits were "
+                "dropped (applied: %s)", skipped,
+                sorted(set(updates) - set(skipped)))
 
     # -- sharding rules -------------------------------------------------
     rules = make_sharding_rules(

@@ -81,19 +81,23 @@ class ModelContext:
                 return cfg
         return None
 
-    def replace_model_config(self, **updates) -> bool:
+    def replace_model_config(self, **updates):
         """For framework models (dataclass cfg): rebuild with new config.
-        Returns False when the model doesn't expose a compatible cfg."""
+
+        Applies the SUPPORTED subset of updates (a config missing one
+        field must not lose the others — e.g. a model without `remat`
+        still gets its dtype and attention kernel set). Returns the list
+        of skipped keys (empty = everything applied), or None when the
+        model doesn't expose a dataclass config at all."""
         cfg = self.model_config()
         if cfg is None or not dataclasses.is_dataclass(cfg):
-            return False
+            return None
         valid = {f.name for f in dataclasses.fields(cfg)}
         usable = {k: v for k, v in updates.items() if k in valid}
-        if len(usable) != len(updates):
-            return False
-        new_cfg = dataclasses.replace(cfg, **usable)
-        self.model = type(self.model)(new_cfg)
-        return True
+        if usable:
+            new_cfg = dataclasses.replace(cfg, **usable)
+            self.model = type(self.model)(new_cfg)
+        return sorted(set(updates) - set(usable))
 
     def make_optimizer(self):
         import optax

@@ -8,7 +8,11 @@ atorch (atorch/modules/transformer/layers.py LlamaAttentionFA etc.). Here:
 - llama.py — LLaMA family (RMSNorm, RoPE, GQA, SwiGLU), the flagship for
   benchmarks; params carry logical axis names that
   dlrover_tpu.parallel.sharding maps onto the device mesh.
+- bert.py  — BERT-family bidirectional encoder (masked LM, post-LN,
+  flash attention with causal=False), ≙ the reference's Megatron BERT
+  blocks + BertAttentionFA.
 """
 
+from dlrover_tpu.models.bert import Bert, BertConfig, mlm_loss
 from dlrover_tpu.models.gpt import GPT, GPTConfig
 from dlrover_tpu.models.llama import Llama, LlamaConfig

@@ -1,0 +1,195 @@
+"""BERT-style bidirectional encoder (masked-LM) in flax.linen.
+
+Capability parity: the reference's encoder model family — atorch ships
+Megatron-parallel BERT blocks (atorch/modules/distributed_modules/
+transformer.py:45, `BertAttentionFA` at modules/transformer/layers.py:740
+pairs them with flash attention via module_replace). TPU re-design: the
+same logical-axis annotations as the Llama/GPT families, so the whole
+strategy table (fsdp/tensor/sequence/data) applies to encoders unchanged,
+and the flash kernel runs with causal=False (full bidirectional
+attention). Post-LN residuals as in original BERT.
+
+Padding is handled the BERT way at the LOSS (masked positions carry
+weight 0 in `mlm_loss`); the attention itself runs over the full padded
+length — on TPU the rectangular kernel beats ragged masking for the
+typical packed-sequence pretraining batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models.llama import dispatch_attention, embed_lookup
+from dlrover_tpu.ops.remat import resolve_remat_policy
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    # "flash" | "reference" | "ring" | "ulysses" — all with causal=False
+    # (long-context ENCODERS work too: the ring's online softmax never
+    # needed causality, only Llama's defaults did)
+    attn_impl: str = "flash"
+    embed_impl: str = "gather"
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+
+    @classmethod
+    def base(cls, **kw) -> "BertConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("max_seq_len", 64)
+        return cls(hidden_size=64, num_layers=2, num_heads=2,
+                   intermediate_size=128, **kw)
+
+    def param_count(self) -> int:
+        h, i = self.hidden_size, self.intermediate_size
+        per_layer = 4 * h * h + 2 * h * i
+        return (self.vocab_size * h + self.max_seq_len * h
+                + self.type_vocab_size * h
+                + self.num_layers * per_layer + h * h)
+
+
+def _logical(init, *axes):
+    return nn.with_logical_partitioning(init, axes)
+
+
+class EncoderBlock(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        batch, seq, _ = x.shape
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        qkv = nn.Dense(
+            3 * cfg.hidden_size, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02),
+                                 "embed", "heads"),
+            bias_init=_logical(nn.initializers.zeros, "heads"),
+            name="qkv",
+        )(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(batch, seq, cfg.num_heads, head_dim)
+                   for t in (q, k, v))
+        attn = dispatch_attention(cfg.attn_impl, q, k, v, causal=False)
+        attn = attn.reshape(batch, seq, cfg.hidden_size)
+        attn = nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02),
+                                 "heads", "embed"),
+            bias_init=_logical(nn.initializers.zeros, "embed"),
+            name="attn_out",
+        )(attn)
+        # post-LN residuals (original BERT)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="attn_norm")(x + attn)
+
+        h = nn.Dense(
+            cfg.intermediate_size, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02),
+                                 "embed", "mlp"),
+            bias_init=_logical(nn.initializers.zeros, "mlp"),
+            name="fc",
+        )(x)
+        h = nn.gelu(h)
+        h = nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=_logical(nn.initializers.normal(0.02),
+                                 "mlp", "embed"),
+            bias_init=_logical(nn.initializers.zeros, "embed"),
+            name="proj",
+        )(h)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                            name="mlp_norm")(x + h)
+
+
+class Bert(nn.Module):
+    """Returns MLM logits (batch, seq, vocab) in fp32; weight-tied
+    decoder over the word-embedding table."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 token_types: jax.Array | None = None) -> jax.Array:
+        cfg = self.config
+        word = self.param(
+            "word_embed",
+            _logical(nn.initializers.normal(0.02), "vocab", "embed"),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype,
+        )
+        pos = self.param(
+            "pos_embed",
+            _logical(nn.initializers.normal(0.02), None, "embed"),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype,
+        )
+        typ = self.param(
+            "type_embed",
+            _logical(nn.initializers.normal(0.02), None, "embed"),
+            (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype,
+        )
+        seq = tokens.shape[-1]
+        x = embed_lookup(word, tokens, cfg) + pos.astype(cfg.dtype)[:seq]
+        if token_types is not None:
+            x = x + typ.astype(cfg.dtype)[token_types]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="embed_norm")(x)
+        block_cls = EncoderBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                EncoderBlock, static_argnums=(),
+                policy=resolve_remat_policy(cfg.remat_policy),
+            )
+        for layer in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"layer_{layer}")(x)
+        # MLM head: dense transform + LN + tied decoder (BERT's
+        # cls/predictions/transform)
+        x = nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            # square transform: column-parallel-style split on the output
+            # ("mlp" -> tensor axis); a logical name may appear only once
+            # per array, so the input dim rides fsdp-free
+            kernel_init=_logical(nn.initializers.normal(0.02),
+                                 "embed", "mlp"),
+            bias_init=_logical(nn.initializers.zeros, "mlp"),
+            name="mlm_transform",
+        )(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="mlm_norm")(x)
+        return jnp.dot(x, word.astype(cfg.dtype).T).astype(jnp.float32)
+
+
+def mlm_loss(logits: jax.Array, targets: jax.Array,
+             weights: jax.Array | None = None) -> jax.Array:
+    """Masked-LM cross entropy. `weights` marks the PREDICTED positions
+    (1 at [MASK]-ed tokens, 0 elsewhere/padding); None scores all
+    positions (the dense-target convenience used by tests)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None],
+                               axis=-1).squeeze(-1)
+    if weights is None:
+        return nll.mean()
+    weights = weights.astype(nll.dtype)
+    return (nll * weights).sum() / jnp.maximum(weights.sum(), 1.0)

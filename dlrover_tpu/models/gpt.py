@@ -15,11 +15,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from dlrover_tpu.models.llama import embed_lookup
-from dlrover_tpu.ops.flash_attention import (
-    mesh_flash_attention,
-    reference_attention,
-)
+from dlrover_tpu.models.llama import dispatch_attention, embed_lookup
+from dlrover_tpu.ops.remat import resolve_remat_policy
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,11 +28,15 @@ class GPTConfig:
     block_size: int = 1024
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # "flash" | "reference" | "ring" | "ulysses" (the SP impls shard the
+    # sequence dim over the mesh's `sequence` axis, as in LlamaConfig)
     attn_impl: str = "flash"
     # GPT is the single-host example family (nanogpt), so the cheap gather
     # lookup is the default; set "onehot" when training on a
     # (data, fsdp, tensor) mesh (see LlamaConfig.embed_impl for why).
     embed_impl: str = "gather"
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
 
     @classmethod
     def nano(cls, **kw) -> "GPTConfig":
@@ -70,15 +71,10 @@ class Block(nn.Module):
             name="qkv",
         )(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q, k, v = (
-            t.reshape(batch, seq, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
-            for t in (q, k, v)
-        )
-        if cfg.attn_impl == "flash":
-            attn = mesh_flash_attention(q, k, v, True)
-        else:
-            attn = reference_attention(q, k, v, True)
-        attn = attn.transpose(0, 2, 1, 3).reshape(batch, seq, cfg.n_embd)
+        q, k, v = (t.reshape(batch, seq, cfg.n_head, head_dim)
+                   for t in (q, k, v))
+        attn = dispatch_attention(cfg.attn_impl, q, k, v, causal=True)
+        attn = attn.reshape(batch, seq, cfg.n_embd)
         x = x + nn.Dense(
             cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             kernel_init=_logical(nn.initializers.normal(0.02),
@@ -122,8 +118,14 @@ class GPT(nn.Module):
         )
         seq = tokens.shape[-1]
         x = embed_lookup(wte, tokens, cfg) + wpe.astype(cfg.dtype)[:seq]
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(
+                Block, static_argnums=(),
+                policy=resolve_remat_policy(cfg.remat_policy),
+            )
         for layer in range(cfg.n_layer):
-            x = Block(cfg, name=f"block_{layer}")(x)
+            x = block_cls(cfg, name=f"block_{layer}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         # weight-tied LM head (as nanoGPT)
         return jnp.dot(x, wte.astype(cfg.dtype).T).astype(jnp.float32)

@@ -170,7 +170,7 @@ def _sequence_parallel_mesh():
     return mesh
 
 
-def _sequence_parallel_attention(impl, mesh, q, k, v):
+def _sequence_parallel_attention(impl, mesh, q, k, v, causal: bool = True):
     """Dispatch to ring/Ulysses attention on (b, seq, heads, dim) arrays;
     k/v carry the (smaller) GQA head count — the kernels replicate heads
     after sharding so only KV-sized bytes ride the ICI.
@@ -189,9 +189,30 @@ def _sequence_parallel_attention(impl, mesh, q, k, v):
     head_axis = (MeshAxis.TENSOR
                  if mesh.shape.get(MeshAxis.TENSOR, 1) > 1 else None)
     if impl == "ulysses":
-        return ulysses_attention(q, k, v, mesh, causal=True,
+        return ulysses_attention(q, k, v, mesh, causal=causal,
                                  head_axis=head_axis)
-    return ring_attention(q, k, v, mesh, causal=True, head_axis=head_axis)
+    return ring_attention(q, k, v, mesh, causal=causal,
+                          head_axis=head_axis)
+
+
+def dispatch_attention(impl: str, q, k, v, causal: bool = True):
+    """Shared attention dispatch for the model families (GPT, BERT, …):
+    (b, seq, heads, dim) in and out, impl = flash | reference | ring |
+    ulysses. The SP impls need an ambient mesh with an active `sequence`
+    axis (build_trainer establishes it at trace time); off-mesh they fall
+    back to the plain path so unit runs stay valid."""
+    if impl in ("ring", "ulysses"):
+        sp_mesh = _sequence_parallel_mesh()
+        if sp_mesh is not None:
+            return _sequence_parallel_attention(impl, sp_mesh, q, k, v,
+                                                causal)
+        impl = "reference"
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    if impl == "flash":
+        out = mesh_flash_attention(qt, kt, vt, causal)
+    else:
+        out = reference_attention(qt, kt, vt, causal)
+    return out.transpose(0, 2, 1, 3)
 
 
 class Attention(nn.Module):

@@ -172,6 +172,42 @@ class TestAutoAccelerate:
         state, metrics = result.step(state, tok, tgt)
         assert np.isfinite(float(metrics["loss"]))
 
+    def test_partial_cfg_support_applies_supported_subset(self,
+                                                          cpu_devices):
+        """A config missing one field (e.g. no `remat`) must still get
+        the edits it DOES support — dtype here — instead of losing the
+        whole batch (the old all-or-nothing behavior silently dropped
+        half/checkpoint/SP edits for any non-Llama family)."""
+        import dataclasses
+
+        import flax.linen as nn
+
+        from dlrover_tpu.auto.model_context import ModelContext
+
+        @dataclasses.dataclass(frozen=True)
+        class MiniCfg:
+            dtype: object = jnp.float32
+
+        class Mini(nn.Module):
+            config: MiniCfg
+
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(8, dtype=self.config.dtype)(x)
+
+        context = ModelContext(
+            Mini(MiniCfg()), sample_batch=np.zeros((1, 4), np.float32),
+            devices=cpu_devices[:1])
+        skipped = context.replace_model_config(
+            dtype=jnp.bfloat16, remat=True)
+        assert skipped == ["remat"]
+        assert context.model_config().dtype == jnp.bfloat16
+        # no dataclass config at all -> None
+        context2 = ModelContext(
+            nn.Dense(4), sample_batch=np.zeros((1, 4), np.float32),
+            devices=cpu_devices[:1])
+        assert context2.replace_model_config(dtype=jnp.bfloat16) is None
+
 
 class TestEngine:
     def test_analyse_reports_size(self):

@@ -129,3 +129,55 @@ def test_ring_attn_impl_off_mesh_falls_back(cpu_devices_module):
     tokens, targets = _data(LlamaConfig.tiny())
     losses = _train_losses(result, tokens, targets, steps=1)
     assert np.isfinite(losses).all()
+
+
+@pytest.mark.parametrize("family", ["gpt", "bert"])
+def test_sp_reaches_all_model_families(cpu_devices_module, family):
+    """attn_impl="ring" is not Llama-only: GPT (causal) and BERT
+    (bidirectional) run the same ring dispatch on a sequence-sharded
+    mesh and match their own single-device reference oracle."""
+    import jax.numpy as jnp
+
+    from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
+    from dlrover_tpu.trainer.train_step import build_trainer
+
+    if family == "gpt":
+        from dlrover_tpu.models.gpt import GPT, GPTConfig
+
+        def make(impl):
+            return GPT(GPTConfig.tiny(attn_impl=impl, dtype=jnp.float32))
+
+        vocab = GPTConfig.tiny().vocab_size
+        loss_fn = cross_entropy_loss
+    else:
+        from dlrover_tpu.models.bert import Bert, BertConfig, mlm_loss
+
+        def make(impl):
+            return Bert(BertConfig.tiny(attn_impl=impl,
+                                        dtype=jnp.float32))
+
+        vocab = BertConfig.tiny().vocab_size
+        loss_fn = lambda logits, tgt: mlm_loss(logits, tgt)  # noqa: E731
+
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, vocab, (BATCH, SEQ), dtype=np.int32)
+
+    def run(model, mesh):
+        trainer = build_trainer(
+            model, optax.adam(1e-3), mesh,
+            np.zeros((BATCH, SEQ), np.int32), loss_fn,
+            accum_steps=1, micro_batch=BATCH)
+        state = trainer.init(jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(2):
+            tok, tgt = trainer.shard_batch(tokens, tokens)
+            state, metrics = trainer.step(state, tok, tgt)
+            losses.append(float(metrics["loss"]))
+        return losses
+
+    base = run(make("reference"),
+               create_mesh(MeshSpec(data=1), cpu_devices_module[:1]))
+    ringed = run(make("ring"),
+                 create_mesh(MeshSpec(sequence=4),
+                             cpu_devices_module[:4]))
+    np.testing.assert_allclose(ringed, base, atol=1e-4, rtol=1e-4)
