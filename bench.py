@@ -153,7 +153,7 @@ def main() -> None:
             size, micro = (LlamaConfig.llama_1b, 4) if lean else (
                 LlamaConfig.llama_410m, 8)
         else:                     # v5e/v5lite-16GB
-            size, micro = (LlamaConfig.llama_1b, 2) if lean else (
+            size, micro = (LlamaConfig.llama_wide_1b, 2) if lean else (
                 LlamaConfig.llama_410m, 8)
         remat = os.environ.get("BENCH_REMAT", "0") == "1"
         cfg = size(max_seq_len=2048, attn_impl="flash", remat=remat,
