@@ -49,6 +49,35 @@ def _model_dims(context: ModelContext) -> Dict[str, int]:
     }
 
 
+def _train_state_bytes(context: ModelContext, abstract_params: Any,
+                       param_count: int, param_bytes: int) -> int:
+    """params + grads + the ACTUAL optimizer state, measured by
+    eval_shape-ing `tx.init` on the abstract params (an adafactor user
+    must not be sized as if they carried fp32 Adam moments — factored
+    state is ~100x leaner). Falls back to the classic Adam-family upper
+    bound (~16 B/param: fp32 master + 2 fp32 moments) when no optimizer
+    factory is available or its init cannot be traced abstractly."""
+    try:
+        tx = context.make_optimizer()
+    except Exception:
+        return param_count * 16
+    try:
+        import flax.linen as nn
+
+        plain = nn.unbox(abstract_params)
+        if isinstance(plain, dict) and "params" in plain:
+            plain = plain["params"]
+        abstract_opt = jax.eval_shape(tx.init, plain)
+        opt_bytes = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(abstract_opt)
+            if hasattr(leaf, "shape"))
+    except Exception:
+        return param_count * 16
+    # params + same-dtype grads + the measured optimizer state
+    return 2 * param_bytes + opt_bytes
+
+
 def analyse(context: ModelContext, micro_batch: int = 1) -> Dict[str, Any]:
     sample = np.asarray(context.infer_sample_batch(micro_batch))
 
@@ -62,9 +91,8 @@ def analyse(context: ModelContext, micro_batch: int = 1) -> Dict[str, Any]:
     param_bytes = sum(
         int(np.prod(leaf.shape)) * leaf.dtype.itemsize for leaf in leaves)
     dtypes = sorted({str(leaf.dtype) for leaf in leaves})
-    # Adam-family training state ≈ params + 2 moments in fp32 + fp32
-    # master copy ⇒ ~16 bytes/param upper bound.
-    train_state_bytes = param_count * 16
+    train_state_bytes = _train_state_bytes(context, abstract, param_count,
+                                           param_bytes)
     device = context.devices[0]
     try:
         hbm_bytes = int(os.environ.get("DLROVER_TPU_HBM_BYTES") or 0)

@@ -28,10 +28,10 @@ def tiny_model():
     return Llama(LlamaConfig.tiny(attn_impl="reference"))
 
 
-def make_context(devices=None):
+def make_context(devices=None, optim_factory=None):
     return ModelContext(
         tiny_model(),
-        optim_factory=lambda lr=1e-3: optax.adamw(lr),
+        optim_factory=optim_factory or (lambda lr=1e-3: optax.adamw(lr)),
         loss_fn=cross_entropy_loss,
         sample_batch=np.zeros((2, 16), np.int32),
         devices=devices,
@@ -215,7 +215,22 @@ class TestEngine:
         cfg = LlamaConfig.tiny()
         assert info["param_count"] == cfg.param_count()
         assert info["n_devices"] >= 1
-        assert info["train_state_bytes"] == info["param_count"] * 16
+        # fp32 params + fp32 grads + measured adamw moments (mu+nu fp32)
+        # ≈ 16 B/param, plus the optimizer's scalar bookkeeping
+        assert (info["train_state_bytes"]
+                >= info["param_count"] * 16) and (
+            info["train_state_bytes"] < info["param_count"] * 16 + 1024)
+
+    def test_analyse_measures_actual_optimizer_state(self):
+        """An adafactor user must not be sized as if they carried fp32
+        Adam moments — the analyser eval_shapes tx.init for the real
+        bytes (factored stats are ~100x leaner)."""
+        import optax
+
+        lean = analyse(make_context(optim_factory=lambda: optax.adafactor(
+            1e-3, min_dim_size_to_factor=8)))  # tiny dims must factor too
+        fat = analyse(make_context())
+        assert lean["train_state_bytes"] < fat["train_state_bytes"] * 0.7
 
     def test_planner_prunes_by_devices(self):
         single = plan_candidates(make_context(jax.devices("cpu")[:1]))
