@@ -165,8 +165,13 @@ def test_network_check_two_node_pair():
         results = {}
 
         def probe(rank):
-            results[rank] = run_network_check(
-                clients[rank], devices_per_node=1, timeout_s=420.0)
+            # capture failures as values: a raising thread must show up
+            # in the assert message, not vanish silently
+            try:
+                results[rank] = run_network_check(
+                    clients[rank], devices_per_node=1, timeout_s=420.0)
+            except Exception as exc:  # noqa: BLE001
+                results[rank] = repr(exc)
 
         threads = [threading.Thread(target=probe, args=(rank,))
                    for rank in (0, 1)]
@@ -177,7 +182,7 @@ def test_network_check_two_node_pair():
             # jax.distributed set with cold compiles — generous budget so
             # a loaded CI machine doesn't flake the verdict
             t.join(timeout=900)
-        assert results == {0: True, 1: True}
+        assert results == {0: True, 1: True}, f"results={results}"
         for c in clients:
             c.close()
     finally:
