@@ -97,3 +97,132 @@ def test_two_process_distributed_training(tmp_path):
         for proc in agents:
             proc.kill()
         master.kill()
+
+
+SCALE_WORKER = """
+from dlrover_tpu.agent.elastic_agent import init_distributed
+init_distributed()
+import jax, sys
+import numpy as np, optax
+from dlrover_tpu.models.llama import Llama, LlamaConfig, cross_entropy_loss
+from dlrover_tpu.trainer.elastic_loop import ElasticTrainLoop, TrainLoopConfig
+
+cfg = LlamaConfig.tiny(attn_impl="reference", norm_impl="reference")
+loop = ElasticTrainLoop(
+    Llama(cfg), optax.adam(1e-3), cross_entropy_loss,
+    TrainLoopConfig(global_batch=4, seq_len=32, max_steps=30,
+                    checkpoint_dir=sys.argv[1], save_interval_steps=2),
+)
+state, start = loop.restore_or_init(jax.random.PRNGKey(0))
+print(f"SCALE world={jax.process_count()} start={start}", flush=True)
+rng = np.random.default_rng(start)
+def gen():
+    import time as _t
+    while True:
+        t = rng.integers(0, cfg.vocab_size, (4, 32), dtype=np.int32)
+        yield t, t
+        _t.sleep(0.3)   # slow steps: the world=1 phase must outlive the
+                        # second agent's arrival
+loop.config.max_steps = 30 - start
+state, metrics = loop.run(state, gen(), start_step=start)
+print(f"SCALE-DONE world={jax.process_count()} "
+      f"step={int(metrics['step'])}", flush=True)
+loop.close()
+"""
+
+
+def test_scale_up_mid_run_through_cli(tmp_path):
+    """Elastic scale-UP e2e: one agent trains at world=1 (min 1 of
+    max 2); a second agent joins mid-run; the master signals the
+    membership change, the agent restarts its worker, and both
+    incarnations re-form at world=2 resuming from the committed
+    checkpoint (start > 0)."""
+    import threading
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    worker = tmp_path / "worker.py"
+    worker.write_text(SCALE_WORKER)
+    ckpt = str(tmp_path / "ckpt")
+
+    master = subprocess.Popen(
+        [sys.executable, "-m", "dlrover_tpu.master.job_master",
+         "--min-nodes", "1", "--max-nodes", "2"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    agents, outputs = [], {}
+    addr_box = {}
+
+    def drain_master():
+        for line in master.stdout:
+            if "addr" not in addr_box and \
+                    "DLROVER_TPU_MASTER_ADDR=" in line:
+                addr_box["addr"] = line.split("=", 1)[1].strip()
+
+    def start_agent(rank):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dlrover_tpu.run",
+             "--nnodes", "1:2", "--node-rank", str(rank),
+             "--master-addr", addr_box["addr"],
+             "--devices-per-node", "2", "--max-restarts", "3",
+             "--monitor-interval", "0.3", str(worker), ckpt],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        agents.append(proc)
+        outputs[rank] = []
+
+        def drain():
+            for line in proc.stdout:
+                outputs[rank].append(line)
+
+        threading.Thread(target=drain, daemon=True).start()
+        return proc
+
+    def saw(rank, needle, timeout=240):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if any(needle in line for line in outputs[rank]):
+                return True
+            time.sleep(0.3)
+        return False
+
+    threading.Thread(target=drain_master, daemon=True).start()
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and "addr" not in addr_box:
+            time.sleep(0.2)
+        assert addr_box.get("addr"), "master never printed its address"
+
+        a0 = start_agent(0)
+        assert saw(0, "SCALE world=1 start=0"), outputs[0]
+        # wait for a COMMITTED checkpoint before the new node arrives
+        # (the first step includes the compile, so a fixed sleep races)
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if os.path.isdir(ckpt) and any(
+                    name.isdigit() and int(name) >= 2
+                    for name in os.listdir(ckpt)):
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError(
+                f"no committed checkpoint at world=1: {outputs[0]}")
+        a1 = start_agent(1)
+
+        assert saw(0, "SCALE world=2"), outputs[0]
+        assert saw(1, "SCALE world=2"), outputs[1]
+        assert a0.wait(timeout=300) == 0, outputs[0]
+        assert a1.wait(timeout=300) == 0, outputs[1]
+        # the restarted incarnation resumed from the checkpoint
+        resumed = [line for line in outputs[0]
+                   if "SCALE world=2 start=" in line]
+        assert resumed and int(
+            resumed[0].split("start=")[1]) > 0, outputs[0]
+        assert saw(0, "SCALE-DONE world=2", timeout=10), outputs[0]
+    finally:
+        for proc in agents:
+            proc.kill()
+        master.kill()
