@@ -20,6 +20,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.common.constants import MeshAxis
+from dlrover_tpu.parallel.moe import moe_aux_loss
 from dlrover_tpu.parallel.sharding import (
     DEFAULT_RULES,
     mesh_shardings,
@@ -92,6 +93,7 @@ def build_trainer(
     rules: Optional[Sequence] = None,
     donate_state: bool = True,
     offload_opt_state: bool = False,
+    rng_seed: int = 0,
 ) -> ShardedTrainer:
     """Lower (model, optimizer, mesh) into init/step programs.
 
@@ -160,13 +162,28 @@ def build_trainer(
 
     def _train_step_body(state: TrainState, tokens, targets):
         params = state.params
+        # Deterministic per-step rng streams for stochastic model paths
+        # (MoE gating jitter, dropout): folded from the step counter so
+        # every restart replays identically, and identical across
+        # replicas as SPMD single-program semantics require.
+        step_key = jax.random.fold_in(jax.random.PRNGKey(rng_seed),
+                                      state.step)
 
         def micro_step(carry, micro):
             loss_acc, grad_acc = carry
-            tok, tgt = micro
+            tok, tgt, idx = micro
+            micro_key = jax.random.fold_in(step_key, idx)
+            rngs = {"gating": jax.random.fold_in(micro_key, 0),
+                    "dropout": jax.random.fold_in(micro_key, 1)}
+
             def compute_loss(p):
-                logits = model.apply({"params": p}, tok)
-                return loss_fn(logits, tgt)
+                # mutable "losses": models sow auxiliary losses there
+                # (MoE router balancing, parallel/moe.py:172); for models
+                # that never sow, the collection is empty and the sum is
+                # 0 — one generic path covers both
+                logits, mutables = model.apply(
+                    {"params": p}, tok, mutable=["losses"], rngs=rngs)
+                return loss_fn(logits, tgt) + moe_aux_loss(mutables)
 
             loss, grads = jax.value_and_grad(compute_loss)(params)
             grad_acc = jax.tree.map(
@@ -179,7 +196,7 @@ def build_trainer(
         )
         (loss_sum, grad_sum), _ = jax.lax.scan(
             micro_step, (jnp.zeros((), jnp.float32), zero_grads),
-            (tokens, targets),
+            (tokens, targets, jnp.arange(accum_steps)),
         )
         grads = jax.tree.map(
             lambda g, p: (g / accum_steps).astype(p.dtype), grad_sum, params
