@@ -417,6 +417,12 @@ def build_pipeline_trainer(cfg: Union[LlamaConfig, GPTConfig],
     mean over its batch rows (cross_entropy_loss qualifies). The pipeline
     applies it per microbatch row and averages — a sum-reducing loss
     would silently change scale vs the dense trainer."""
+    if getattr(cfg, "num_experts", 0) > 1:
+        # LlamaMoEConfig subclasses LlamaConfig: without this guard an
+        # MoE config would silently pipeline as a DENSE Llama
+        raise NotImplementedError(
+            "pipeline lowering does not support MoE configs; run MoE "
+            "under expert_parallel (the expert axis) instead")
     if isinstance(cfg, LlamaConfig):
         spec = llama_pipeline_spec(cfg, seq_len, loss_fn)
     elif isinstance(cfg, GPTConfig):

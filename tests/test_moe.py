@@ -234,3 +234,24 @@ class TestMoEProductPath:
         _, m_again = trainer.step(state2, tok, tgt)
         np.testing.assert_allclose(float(m_again["loss"]), losses[0],
                                    rtol=1e-6)
+
+    def test_moe_config_rejected_by_pipeline_lowering(self):
+        """LlamaMoEConfig subclasses LlamaConfig; the pipeline lowering
+        must refuse it loudly instead of silently pipelining a DENSE
+        Llama built from the MoE dims."""
+        import optax
+        import pytest
+
+        from dlrover_tpu.models.llama import cross_entropy_loss
+        from dlrover_tpu.models.llama_moe import LlamaMoEConfig
+        from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
+        from dlrover_tpu.trainer.pipeline_trainer import (
+            build_pipeline_trainer,
+        )
+
+        cfg = LlamaMoEConfig.mixtral_tiny(attn_impl="reference")
+        mesh = create_mesh(MeshSpec(pipe=2), jax.devices("cpu")[:2])
+        with pytest.raises(NotImplementedError, match="MoE"):
+            build_pipeline_trainer(
+                cfg, optax.adam(1e-3), mesh, num_microbatches=2,
+                micro_batch=2, seq_len=16, loss_fn=cross_entropy_loss)
