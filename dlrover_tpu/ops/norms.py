@@ -13,8 +13,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
 
 from dlrover_tpu.ops.flash_attention import _vma
 
