@@ -296,8 +296,14 @@ def init_distributed() -> None:
         return
     import jax
 
+    # Default 300 s coordinator-registration deadline is too tight when
+    # several probe/worker processes cold-compile on a loaded shared host
+    # (observed: DEADLINE_EXCEEDED on CoordinationService/RegisterTask) —
+    # give registration the same generous budget the agent gives compiles.
+    init_timeout = int(os.getenv("DLROVER_TPU_DIST_INIT_TIMEOUT", "600"))
     jax.distributed.initialize(
         coordinator_address=os.environ[NodeEnv.COORDINATOR_ADDR],
         num_processes=world_size,
         process_id=int(os.environ[NodeEnv.PROCESS_ID]),
+        initialization_timeout=init_timeout,
     )

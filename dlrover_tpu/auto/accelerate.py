@@ -155,6 +155,7 @@ def lower(context: ModelContext) -> AccelerateResult:
     sample = context.infer_sample_batch(micro)
 
     if plan.pipeline_stages > 1:
+        from dlrover_tpu.models.bert import BertConfig
         from dlrover_tpu.models.gpt import GPTConfig
         from dlrover_tpu.models.llama import LlamaConfig
         from dlrover_tpu.trainer.pipeline_trainer import (
@@ -162,16 +163,12 @@ def lower(context: ModelContext) -> AccelerateResult:
         )
 
         cfg = context.model_config()
-        if not isinstance(cfg, (LlamaConfig, GPTConfig)):
+        if not isinstance(cfg, (LlamaConfig, GPTConfig, BertConfig)):
             raise NotImplementedError(
                 "pipeline lowering needs a stacked-block model config "
-                "(LlamaConfig or GPTConfig); for custom models build a "
-                "PipelineModelSpec and a PipelinedTrainer directly "
-                "(dlrover_tpu.trainer.pipeline_trainer)")
-        if plan.offload_optimizer:
-            logger.warning(
-                "offload_optimizer is not implemented for the pipeline "
-                "trainer yet; optimizer state stays in device memory")
+                "(LlamaConfig, GPTConfig, or BertConfig); for custom "
+                "models build a PipelineModelSpec and a PipelinedTrainer "
+                "directly (dlrover_tpu.trainer.pipeline_trainer)")
         if plan.global_batch:
             # the accumulation geometry IS the microbatch stream: the
             # user's global batch is authoritative (accum × micro rows)
@@ -185,6 +182,7 @@ def lower(context: ModelContext) -> AccelerateResult:
             loss_fn=context.loss_fn, remat=plan.remat,
             num_rounds=plan.pipeline_rounds,
             rules=rules,
+            offload_opt_state=plan.offload_optimizer,
         )
         return AccelerateResult(trainer=trainer, mesh=mesh,
                                 model=context.model, strategy=[],

@@ -46,6 +46,7 @@ def _model_dims(context: ModelContext) -> Dict[str, int]:
         "num_kv_heads": get("num_kv_heads", "num_heads", "n_head"),
         "vocab_size": get("vocab_size"),
         "intermediate_size": get("intermediate_size"),
+        "num_experts": get("num_experts"),
     }
 
 
@@ -55,12 +56,13 @@ def _train_state_bytes(context: ModelContext, abstract_params: Any,
     eval_shape-ing `tx.init` on the abstract params (an adafactor user
     must not be sized as if they carried fp32 Adam moments — factored
     state is ~100x leaner). Falls back to the classic Adam-family upper
-    bound (~16 B/param: fp32 master + 2 fp32 moments) when no optimizer
-    factory is available or its init cannot be traced abstractly."""
+    bound (~20 B/param: fp32 master + 2 fp32 moments + grad + fp32
+    accumulator) when no optimizer factory is available or its init
+    cannot be traced abstractly."""
     try:
         tx = context.make_optimizer()
     except Exception:
-        return param_count * 16
+        return param_count * 20
     try:
         import flax.linen as nn
 
@@ -73,9 +75,11 @@ def _train_state_bytes(context: ModelContext, abstract_params: Any,
             for leaf in jax.tree.leaves(abstract_opt)
             if hasattr(leaf, "shape"))
     except Exception:
-        return param_count * 16
-    # params + same-dtype grads + the measured optimizer state
-    return 2 * param_bytes + opt_bytes
+        return param_count * 20
+    # params + one transient same-dtype grad (live during value_and_grad)
+    # + the persistent fp32 grad accumulator build_trainer carries
+    # (trainer/train_step.py micro_step) + the measured optimizer state
+    return 2 * param_bytes + param_count * 4 + opt_bytes
 
 
 def analyse(context: ModelContext, micro_batch: int = 1) -> Dict[str, Any]:
@@ -151,16 +155,32 @@ def size_axes(info: Dict[str, Any]) -> Dict[str, Any]:
        that even a single layer's width-sharded activations blow the
        budget), shard the sequence dim over remaining devices (ring
        attention keeps the math exact).
-    5. data: whatever devices remain.
+    5. expert: for MoE configs (num_experts > 1), the largest divisor
+       of the remaining devices that divides the expert count — expert
+       weights dominate MoE state, and the expert axis shards them
+       with one all-to-all per MoE layer instead of fsdp's per-matmul
+       re-gathers.
+    6. data: whatever devices remain.
 
-    Returns {"fsdp", "tensor", "sequence", "data", "remat"}; all
-    1/False when the device HBM is unknown (nothing to size against).
+    Returns {"fsdp", "tensor", "sequence", "expert", "data", "remat"};
+    sizes are all 1 when the device HBM is unknown, EXCEPT expert,
+    which depends only on the model config and device count.
     """
     n_devices = info["n_devices"]
     hbm = info["device_hbm_bytes"]
+
+    def _expert_size(remaining: int) -> int:
+        experts = info.get("num_experts", 0) or 0
+        if experts <= 1 or remaining < 2:
+            return 1
+        return max((d for d in _divisors_of(remaining)
+                    if d <= experts and experts % d == 0), default=1)
+
     if not hbm or n_devices < 1:
-        return {"fsdp": 1, "tensor": 1, "sequence": 1,
-                "data": n_devices or 1, "remat": False}
+        expert = _expert_size(n_devices or 1)
+        return {"fsdp": 1, "tensor": 1, "sequence": 1, "expert": expert,
+                "data": max(1, (n_devices or 1) // expert),
+                "remat": False}
     state_budget = hbm * STATE_HBM_FRACTION
     state = info["train_state_bytes"]
 
@@ -194,6 +214,7 @@ def size_axes(info: Dict[str, Any]) -> Dict[str, Any]:
                 if act_eff / (tensor * d) <= act_budget:
                     break
 
-    data = n_devices // (fsdp * tensor * sequence)
+    expert = _expert_size(n_devices // (fsdp * tensor * sequence))
+    data = n_devices // (fsdp * tensor * sequence * expert)
     return {"fsdp": fsdp, "tensor": tensor, "sequence": sequence,
-            "data": max(1, data), "remat": remat}
+            "expert": expert, "data": max(1, data), "remat": remat}

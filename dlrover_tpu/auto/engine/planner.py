@@ -18,16 +18,33 @@ from dlrover_tpu.auto.opt_lib import SEMIAUTO_STRATEGIES, OptimizationLibrary
 from dlrover_tpu.auto.strategy import Strategy
 
 
+def _pipeline_size(info, n_devices: int) -> int:
+    """A sized pipeline candidate is warranted when the model is deep
+    enough to cut into balanced stages and its state pressures HBM
+    (pipe shards params by depth with one p2p per boundary instead of
+    fsdp's per-matmul re-gathers — the winner when the data axis would
+    ride a slow fabric). Returns 1 when not warranted."""
+    layers = info.get("num_layers", 0) or 0
+    if layers < 4 or n_devices < 2 or info["fits_one_device"]:
+        return 1
+    for stages in (4, 2):
+        if n_devices % stages == 0 and layers % stages == 0:
+            return stages
+    return 1
+
+
 def _sized_candidates(info, n_devices: int) -> List[Strategy]:
     """Model-aware sized strategies, best-guess first plus neighbors."""
     sizing = size_axes(info)
     # (sequence > 1 implies remat per size_axes's ordering, so these two
     # conditions also cover the long-context case)
-    if sizing["fsdp"] <= 1 and not sizing["remat"]:
+    if (sizing["fsdp"] <= 1 and not sizing["remat"]
+            and sizing["expert"] <= 1):
         return []
 
     def build(fsdp: int, tensor: int, remat: bool,
-              sequence: int = 1) -> Strategy:
+              sequence: int = 1, expert: int = 1,
+              pipe: int = 1) -> Strategy:
         strategy: Strategy = [("half", {}), ("module_replace", {})]
         if fsdp > 1:
             strategy.append(("fsdp", {"size": fsdp}))
@@ -35,22 +52,33 @@ def _sized_candidates(info, n_devices: int) -> List[Strategy]:
             strategy.append(("tensor_parallel", {"size": tensor}))
         if sequence > 1:
             strategy.append(("sequence_parallel", {"size": sequence}))
+        if expert > 1:
+            strategy.append(("expert_parallel", {"size": expert}))
+        if pipe > 1:
+            strategy.append(("pipeline_parallel", {"size": pipe}))
         if remat:
             strategy.append(("checkpoint", {}))
         return strategy
 
     candidates = [build(sizing["fsdp"], sizing["tensor"], sizing["remat"],
-                        sizing["sequence"])]
+                        sizing["sequence"], sizing["expert"])]
     # neighbors: one rung more sharding (cheaper HBM, more comm) and the
     # remat flip, so the dry-run can catch a mis-estimate
     more_fsdp = sizing["fsdp"] * 2
-    if more_fsdp * sizing["tensor"] * sizing["sequence"] <= n_devices and (
-            n_devices % (more_fsdp * sizing["tensor"]
-                         * sizing["sequence"]) == 0):
+    fixed = (more_fsdp * sizing["tensor"] * sizing["sequence"]
+             * sizing["expert"])
+    if fixed <= n_devices and n_devices % fixed == 0:
         candidates.append(build(more_fsdp, sizing["tensor"],
-                                sizing["remat"], sizing["sequence"]))
+                                sizing["remat"], sizing["sequence"],
+                                sizing["expert"]))
     candidates.append(build(sizing["fsdp"], sizing["tensor"],
-                            not sizing["remat"], sizing["sequence"]))
+                            not sizing["remat"], sizing["sequence"],
+                            sizing["expert"]))
+    # depth-sharded alternative: pipeline stages instead of fsdp, the
+    # remaining devices on data — the dry-run arbitrates
+    pipe = _pipeline_size(info, n_devices)
+    if pipe > 1 and not info.get("num_experts", 0):
+        candidates.append(build(1, 1, sizing["remat"], 1, 1, pipe))
     return candidates
 
 
@@ -70,6 +98,13 @@ def plan_candidates(context: ModelContext,
     forced: Strategy = []
     if not info["fits_one_device"] and n_devices > 1:
         forced.append(("fsdp", {}))
+    # MoE models must get the expert axis considered: without it every
+    # candidate densifies the expert weights onto each device (reference
+    # analog: optimization_library registers expert/pipe passes the
+    # engine may propose, optimization_library.py:38-53)
+    sizing = size_axes(info)
+    if sizing["expert"] > 1:
+        forced.append(("expert_parallel", {"size": sizing["expert"]}))
 
     optional: List[str] = []
     for name in SEMIAUTO_STRATEGIES:
@@ -82,6 +117,17 @@ def plan_candidates(context: ModelContext,
             continue
         optional.append(name)
 
+    extras: List[Strategy] = []
+    pipe = _pipeline_size(info, n_devices)
+    if pipe > 1 and sizing["expert"] <= 1:
+        extras.append([("half", {}), ("module_replace", {}),
+                       ("pipeline_parallel", {"size": pipe})])
+    if not info["fits_one_device"]:
+        # host-offloaded optimizer state: the single-device escape hatch
+        # (and an fsdp alternative the dry-run can score)
+        extras.append([("half", {}), ("module_replace", {}),
+                       ("offload_optimizer", {})])
+
     # smallest first: baseline (forced only), then singles, then pairs, ...
     for size in range(0, len(optional) + 1):
         for combo in combinations(optional, size):
@@ -93,4 +139,10 @@ def plan_candidates(context: ModelContext,
                 candidates.append(strategy)
                 if len(candidates) >= max_candidates:
                     return candidates
+        if size == 1:
+            for strategy in extras:
+                if strategy not in candidates:
+                    candidates.append(strategy)
+                    if len(candidates) >= max_candidates:
+                        return candidates
     return candidates

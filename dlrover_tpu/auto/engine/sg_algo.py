@@ -34,6 +34,7 @@ _PASS_VOCAB = (
     "checkpoint",
     "fsdp",
     "zero1",
+    "zero2",
     "tensor_parallel",
     "pipeline_parallel",
     "sequence_parallel",
@@ -42,12 +43,22 @@ _PASS_VOCAB = (
     "offload_optimizer",
 )
 _OVERFLOW = len(_PASS_VOCAB)
-_N_FEATURES = _OVERFLOW + 1 + 2  # vocab + overflow + log2(fsdp), log2(tensor)
+# vocab + overflow + log2 sizes of every sized axis pass — candidates
+# differing only in an axis size must map to distinct feature vectors,
+# or the GP treats them as one point and EI never explores the variants
+_SIZED_SLOTS = {
+    "fsdp": 0, "zero1": 0, "zero2": 0,
+    "tensor_parallel": 1,
+    "sequence_parallel": 2,
+    "expert_parallel": 3,
+    "pipeline_parallel": 4,
+}
+_N_FEATURES = _OVERFLOW + 1 + 1 + max(_SIZED_SLOTS.values())
 
 
 def featurize(strategy: Strategy) -> np.ndarray:
     """Map a strategy (list of (pass_name, config)) to a fixed vector:
-    per-pass indicators plus log2 of the fsdp/tensor axis sizes."""
+    per-pass indicators plus log2 of each sized axis."""
     x = np.zeros(_N_FEATURES, dtype=np.float64)
     for name, config in strategy:
         try:
@@ -55,11 +66,9 @@ def featurize(strategy: Strategy) -> np.ndarray:
         except ValueError:
             x[_OVERFLOW] = 1.0
         size = int((config or {}).get("size", 0))
-        if size > 1:
-            if name in ("fsdp", "zero1"):
-                x[_OVERFLOW + 1] = math.log2(size)
-            elif name == "tensor_parallel":
-                x[_OVERFLOW + 2] = math.log2(size)
+        slot = _SIZED_SLOTS.get(name)
+        if size > 1 and slot is not None:
+            x[_OVERFLOW + 1 + slot] = math.log2(size)
     return x
 
 

@@ -4,3 +4,9 @@ from dlrover_tpu.checkpoint.flash_checkpoint import (  # noqa: F401
     FlashCheckpointer,
     abstract_state_for,
 )
+from dlrover_tpu.checkpoint.quantized import (  # noqa: F401
+    abstract_encoded,
+    decode_tree,
+    encode_tree,
+    encoded_nbytes,
+)

@@ -1,0 +1,141 @@
+"""Scriptable fault injection for chaos testing.
+
+Capability parity: the reference ships chaosblade-driven chaos jobs
+(/root/reference/examples/pytorch/mnist/start_chaos.sh +
+chaos_test_job.yaml — kill/cpu-load a chosen pod while the job runs) to
+demonstrate recovery. TPU re-design: no external agent — a worker-side
+hook the train loop polls each step, scripted through one env var, so a
+chaos run is just a normal job launch with `DLROVER_TPU_CHAOS` set on
+(or forwarded to) the chosen node.
+
+Spec grammar (semicolon-separated faults):
+
+    DLROVER_TPU_CHAOS="action:role:rank@step[:duration]"
+
+    kill:worker:0@5        SIGKILL worker rank 0 when it reaches step 5
+    hang:worker:1@3:120    rank 1 blocks 120 s at step 3 (hang detector
+                           / straggler territory)
+    slow:worker:2@4:0.5    rank 2 sleeps 0.5 s EVERY step from step 4 on
+                           (a straggler the network-check/speed paths
+                           should flag)
+
+Each kill/hang fault fires at most once per process; slow applies from
+its step onward. The hook is a no-op (one env read at construction)
+when the variable is unset — zero cost on the training path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+from typing import List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+CHAOS_ENV = "DLROVER_TPU_CHAOS"
+# directory recording fired one-shot faults: makes kill/hang fire once
+# per JOB rather than once per process (a respawned worker re-parses the
+# same env; without the marker a kill fault would SIGKILL every
+# incarnation and exhaust the restart budget). Unset = per-process only.
+CHAOS_STATE_ENV = "DLROVER_TPU_CHAOS_STATE"
+
+
+@dataclasses.dataclass
+class ChaosFault:
+    action: str            # "kill" | "hang" | "slow"
+    role: str              # node type the fault targets ("worker", …)
+    rank: int              # node rank within the role
+    at_step: int           # fire when the target reaches this step
+    duration: float = 60.0  # hang: block seconds; slow: sleep/step
+    fired: bool = False
+
+
+def parse_chaos(spec: str) -> List[ChaosFault]:
+    """Parse the CHAOS_ENV grammar; raises ValueError on a bad spec (a
+    chaos run with a typo'd fault must fail loudly, not run clean)."""
+    faults = []
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        try:
+            head, at = part.split("@", 1)
+            action, role, rank = head.split(":")
+            at_fields = at.split(":")
+            fault = ChaosFault(
+                action=action.strip().lower(), role=role.strip(),
+                rank=int(rank), at_step=int(at_fields[0]),
+            )
+            if len(at_fields) > 1:
+                fault.duration = float(at_fields[1])
+        except (ValueError, IndexError) as e:
+            raise ValueError(
+                f"bad chaos fault {part!r} (want "
+                f"'action:role:rank@step[:duration]'): {e}") from e
+        if fault.action not in ("kill", "hang", "slow"):
+            raise ValueError(f"unknown chaos action {fault.action!r}")
+        faults.append(fault)
+    return faults
+
+
+class ChaosInjector:
+    """Per-process injector; construct once, call maybe_inject per step."""
+
+    def __init__(self, role: str = "worker",
+                 rank: Optional[int] = None,
+                 spec: Optional[str] = None):
+        from dlrover_tpu.common.constants import NodeEnv
+
+        spec = spec if spec is not None else os.environ.get(CHAOS_ENV, "")
+        if rank is None:
+            rank = int(os.environ.get(NodeEnv.NODE_RANK, "0"))
+        self._role = role
+        self._rank = rank
+        self._state_dir = os.environ.get(CHAOS_STATE_ENV, "")
+        self.faults = [
+            f for f in parse_chaos(spec)
+            if f.role == role and f.rank == rank
+        ] if spec else []
+        for fault in self.faults:
+            if self._already_fired(fault):
+                fault.fired = True
+        if self.faults:
+            logger.warning("chaos injector ARMED for %s-%d: %s",
+                           role, rank, self.faults)
+
+    def _marker(self, fault: ChaosFault) -> str:
+        return os.path.join(
+            self._state_dir,
+            f"chaos_{fault.action}_{fault.role}_{fault.rank}"
+            f"_{fault.at_step}")
+
+    def _already_fired(self, fault: ChaosFault) -> bool:
+        return bool(self._state_dir) and os.path.exists(
+            self._marker(fault))
+
+    def _record_fired(self, fault: ChaosFault) -> None:
+        fault.fired = True
+        if self._state_dir:
+            os.makedirs(self._state_dir, exist_ok=True)
+            with open(self._marker(fault), "w") as f:
+                f.write(str(os.getpid()))
+
+    def maybe_inject(self, step: int) -> None:
+        for fault in self.faults:
+            if fault.fired or step < fault.at_step:
+                continue
+            if fault.action == "kill":
+                logger.warning("chaos: SIGKILL self (%s-%d) at step %d",
+                               self._role, self._rank, step)
+                # record BEFORE dying, or the respawned incarnation
+                # replays the fault forever
+                self._record_fired(fault)
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif fault.action == "hang":
+                self._record_fired(fault)
+                logger.warning("chaos: hanging %s-%d for %.1fs at step %d",
+                               self._role, self._rank, fault.duration,
+                               step)
+                time.sleep(fault.duration)
+            elif fault.action == "slow":
+                # applies every step from at_step on (a real straggler)
+                time.sleep(fault.duration)

@@ -130,6 +130,13 @@ def _probe_round(client: MasterClient, devices_per_node: int,
         NodeEnv.COORDINATOR_ADDR: coord,
         _RESULT_FILE_ENV: result_file,
     })
+    # Round 1 re-runs the same probe program in a fresh process; a shared
+    # persistent compile cache lets it skip the cold compile that makes a
+    # loaded 1-core host starve the coordination-service deadline.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(tempfile.gettempdir(),
+                                "dlrover_tpu_nc_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     t0 = time.perf_counter()
     try:
         proc = subprocess.run(

@@ -8,7 +8,7 @@ logical-axis rules instead of parallel module classes, and XLA-inserted
 collectives over ICI/DCN.
 """
 
-from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
+from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh, use_mesh
 from dlrover_tpu.parallel.sharding import (
     DEFAULT_RULES,
     make_sharding_rules,

@@ -10,15 +10,21 @@ the tightest ICI loops.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
+import logging
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
+from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 from dlrover_tpu.common.constants import MeshAxis
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,31 +81,114 @@ class MeshSpec:
         return cls(**sizes)
 
 
+def _dcn_split(spec: MeshSpec, n_granules: int) -> Optional[List[int]]:
+    """Split one mesh axis across the slow (DCN) fabric.
+
+    Returns the per-axis DCN shape (same order as ``axis_sizes``), or
+    None when no single axis divides evenly by the granule count.
+    Preference order: data, then pipe, then fsdp — gradient all-reduce
+    over data tolerates DCN latency best (it overlaps with backward),
+    pipe crosses the fabric once per microbatch boundary, while
+    tensor/sequence/expert collectives are latency-bound and must stay
+    on ICI (SURVEY §2.5)."""
+    sizes = spec.axis_sizes()
+    dcn = [1] * len(sizes)
+    preference = (MeshAxis.DATA, MeshAxis.PIPE, MeshAxis.FSDP)
+    for axis in preference:
+        idx = next(i for i, (name, _) in enumerate(sizes) if name == axis)
+        if sizes[idx][1] % n_granules == 0:
+            dcn[idx] = n_granules
+            return dcn
+    return None
+
+
 def create_mesh(spec: Optional[MeshSpec] = None,
                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build the mesh. All axes always exist (size 1 when unused) so
-    partition specs never have to special-case a missing axis."""
+    """Build the mesh, topology-aware. All axes always exist (size 1 when
+    unused) so partition specs never have to special-case a missing axis.
+
+    Device→coordinate assignment goes through
+    ``mesh_utils.create_device_mesh`` so mesh axes map onto contiguous
+    ICI rings/tori of the physical TPU topology (the reference plans
+    groups over the physical fabric the same way:
+    atorch/auto/opt_lib/shard_planners/mip_tp_planner.py:30 + NCCL's
+    topology detection). Multi-process jobs spanning slices get a hybrid
+    ICI×DCN mesh with the data (or pipe) axis across the slow fabric.
+    Falls back to a row-major reshape for device subsets or shapes the
+    topology solver rejects (CPU test meshes, partial-chip benches)."""
     devices = list(devices if devices is not None else jax.devices())
     spec = (spec or MeshSpec()).with_total_devices(len(devices))
     names = tuple(name for name, _ in spec.axis_sizes())
     shape = tuple(size for _, size in spec.axis_sizes())
-    array = np.asarray(devices).reshape(shape)
+
+    # DCN granules are SLICES when the platform reports them (a
+    # multi-host single-slice pod is all-ICI: plain topology assignment
+    # is correct there); otherwise each process is its own DCN domain
+    # (CPU meshes, non-slice platforms).
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None in slice_ids:
+        n_granules = len({d.process_index for d in devices})
+        process_is_granule = True
+    else:
+        n_granules = len(slice_ids)
+        process_is_granule = False
+    array: Optional[np.ndarray] = None
+    if n_granules > 1:
+        dcn_shape = _dcn_split(spec, n_granules)
+        if dcn_shape is None:
+            logger.warning(
+                "mesh spec %s has no axis divisible by %d DCN granules; "
+                "falling back to granule-major reshape — cross-DCN "
+                "collectives on fast axes will be slow", spec, n_granules)
+        else:
+            per_granule = tuple(s // d for s, d in zip(shape, dcn_shape))
+            try:
+                array = mesh_utils.create_hybrid_device_mesh(
+                    per_granule, dcn_shape, devices=devices,
+                    process_is_granule=process_is_granule,
+                    allow_split_physical_axes=True)
+            except (ValueError, NotImplementedError, AssertionError) as e:
+                logger.warning("hybrid device mesh failed (%s); "
+                               "falling back to reshape", e)
+    else:
+        try:
+            array = mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True)
+        except (ValueError, NotImplementedError, AssertionError) as e:
+            # Subsets of a slice (bench on 1 of N chips) and CPU test
+            # meshes have no topology to exploit — row-major is correct
+            # there; on a full slice this path never triggers.
+            logger.debug("topology mesh assignment unavailable (%s); "
+                         "using row-major order", e)
+    if array is None:
+        array = np.asarray(devices).reshape(shape)
     return Mesh(array, names)
 
 
+# Ambient-mesh context: an explicit, public alternative to reading
+# jax's private thread_resources. build_trainer (and anything tracing
+# model code) enters use_mesh() so ring/Ulysses attention can reach the
+# concrete mesh for their inner shard_map at trace time without the
+# model carrying the mesh through its config.
+_AMBIENT_MESH: contextvars.ContextVar[Optional[Mesh]] = \
+    contextvars.ContextVar("dlrover_tpu_ambient_mesh", default=None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Enter ``mesh`` as the ambient mesh (also enters jax's own mesh
+    context so flax logical-axis machinery sees it)."""
+    token = _AMBIENT_MESH.set(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _AMBIENT_MESH.reset(token)
+
+
 def current_mesh() -> Optional[Mesh]:
-    """The ambient physical mesh (set by ``with mesh:``), or None.
-
-    Model code that needs a concrete mesh for an inner ``shard_map``
-    (ring/Ulysses attention) reads it from here at trace time —
-    build_trainer enters the mesh context around tracing, so the model
-    never has to carry the mesh through its config."""
-    from jax._src import mesh as mesh_lib  # no public accessor yet
-
-    physical = mesh_lib.thread_resources.env.physical_mesh
-    if physical.devices.size:
-        return physical
-    return None
+    """The ambient mesh set by :func:`use_mesh`, or None."""
+    return _AMBIENT_MESH.get()
 
 
 def data_axes(mesh: Mesh) -> Tuple[str, ...]:

@@ -193,8 +193,17 @@ def pipeline_train(
     num_rounds: int = 1,
     axis: str = MeshAxis.PIPE,
     remat: bool = False,
+    chunk_has_aux: bool = False,
 ) -> jax.Array:
     """Circular (interleaved) pipeline producing the mean microbatch loss.
+
+    chunk_has_aux: chunk_fn returns (act, aux_scalar) — per-chunk
+    auxiliary losses (MoE router load-balancing) accumulated over every
+    VALID (chunk, microbatch) pair and folded into the returned loss as
+    their microbatch mean, matching the dense trainer's
+    `ce + moe_aux_loss` objective (models/llama_moe.py
+    moe_cross_entropy_loss; each chunk sees each microbatch exactly
+    once, so the sum over valid steps is the sum over layers).
 
     The schedule generalizes GPipe the way Megatron's interleaved 1F1B
     generalizes plain 1F1B (reference: PiPPy schedules consumed at
@@ -257,7 +266,7 @@ def pipeline_train(
         S, C, M = num_stages, num_rounds, num_micro
 
         def step(carry, t):
-            act, loss_rows = carry
+            act, loss_rows, aux_acc = carry
             ts = t - stage
             # the activation arriving here was injected at stage 0 at
             # step ts − r·S; see the schedule proof in the docstring
@@ -278,7 +287,12 @@ def pipeline_train(
                 lambda p: lax.dynamic_index_in_dim(p, r, 0,
                                                    keepdims=False),
                 local_chunks)
-            y = fn(params_r, x)
+            if chunk_has_aux:
+                y, aux = fn(params_r, x)
+                aux_acc = aux_acc + jnp.where(
+                    valid, aux.astype(jnp.float32), 0.0)
+            else:
+                y = fn(params_r, x)
 
             def take_loss(_):
                 tgt = lax.dynamic_index_in_dim(targets, m_safe, 0,
@@ -291,15 +305,16 @@ def pipeline_train(
             loss_rows = loss_rows + jnp.where(do_loss, take_loss(None),
                                               0.0)
             act = lax.ppermute(y, axis, fwd_perm)
-            return (act, loss_rows), None
+            return (act, loss_rows, aux_acc), None
 
         act0 = _varying(jnp.zeros(act_shape.shape, act_shape.dtype), axis)
         loss0 = _varying(jnp.zeros((micro,), jnp.float32), axis)
-        (_, loss_rows), _ = lax.scan(step, (act0, loss0),
-                                     jnp.arange(steps))
+        aux0 = _varying(jnp.zeros((), jnp.float32), axis)
+        (_, loss_rows, aux_acc), _ = lax.scan(step, (act0, loss0, aux0),
+                                              jnp.arange(steps))
         # only the last stage accumulated anything; reductions (pipe
         # psum here, row mean outside) stay OUT of the cond branches
-        return lax.psum(loss_rows, axis)
+        return lax.psum(loss_rows, axis), lax.psum(aux_acc, axis)
 
     params_spec = jax.tree.map(lambda _: P(None, axis), chunk_params)
     rep = jax.tree.map(lambda _: P(), shared_params)
@@ -307,13 +322,19 @@ def pipeline_train(
         body,
         mesh=mesh,
         in_specs=(params_spec, rep, P(), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names=frozenset({axis}),
     )
-    loss_rows = piped(chunk_params, shared_params, tokens, targets)
+    loss_rows, aux_total = piped(chunk_params, shared_params, tokens,
+                                 targets)
     # mean over all M·micro rows; the cross-replica reduce of the row
-    # mean happens here, outside the pipeline scan
-    return jnp.mean(loss_rows) / num_micro
+    # mean happens here, outside the pipeline scan. Aux losses: each
+    # (chunk, microbatch) contributed once → microbatch mean matches the
+    # dense objective's per-batch aux sum.
+    loss = jnp.mean(loss_rows) / num_micro
+    if chunk_has_aux:
+        loss = loss + aux_total / num_micro
+    return loss
 
 
 def stack_stage_params(per_stage_params) -> Any:

@@ -44,6 +44,11 @@ class TrainLoopConfig:
     max_steps: int = 0                    # 0 = until data exhausted
     checkpoint_dir: str = ""
     save_interval_steps: int = 100
+    # 8/4 = groupwise int-quantized state payloads (~4x fewer restore
+    # bytes; see checkpoint/quantized.py); 0 = exact dtypes
+    checkpoint_quantize_bits: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "DLROVER_TPU_CKPT_QUANT_BITS", "0")))
     report_interval_steps: int = 10
     mesh_spec: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     rules: Optional[Any] = None
@@ -98,10 +103,12 @@ class ElasticTrainLoop:
             )
         self.checkpointer = (
             FlashCheckpointer(config.checkpoint_dir,
-                              config.save_interval_steps)
+                              config.save_interval_steps,
+                              quantize_bits=config.checkpoint_quantize_bits)
             if config.checkpoint_dir else None
         )
         self._stop_requested = threading.Event()
+        self._chaos = None  # built lazily: env may be set post-init
         self._prev_sigterm = None
         self._profiling = False
         logger.info(
@@ -194,11 +201,17 @@ class ElasticTrainLoop:
                    raw_metrics):
         config = self.config
         step = start_step
+        if self._chaos is None:
+            from dlrover_tpu.diagnostics.chaos import ChaosInjector
+
+            self._chaos = ChaosInjector()
         for tokens, targets in batches:
             self._maybe_profile(step - start_step)
             tok, tgt = self.trainer.shard_batch(tokens, targets)
             state, raw_metrics = self.trainer.step(state, tok, tgt)
             step += 1
+            # scripted fault injection (no-op unless DLROVER_TPU_CHAOS)
+            self._chaos.maybe_inject(step)
             if sampler is not None:
                 sampler.record_batch(config.global_batch)
             if (self.client is not None

@@ -20,6 +20,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.common.constants import MeshAxis
+from dlrover_tpu.parallel.mesh import use_mesh
 from dlrover_tpu.parallel.moe import moe_aux_loss
 from dlrover_tpu.parallel.sharding import (
     DEFAULT_RULES,
@@ -122,7 +123,7 @@ def build_trainer(
     # code can reach the concrete mesh at trace time (current_mesh() —
     # ring/Ulysses attention build an inner shard_map from it), including
     # re-traces from eval_shape in the checkpoint-restore path.
-    with mesh:
+    with use_mesh(mesh):
         abstract_boxed = jax.eval_shape(
             _init_boxed, jax.random.key(0)
         )
@@ -148,7 +149,7 @@ def build_trainer(
     )
 
     def _init(rng):
-        with mesh:
+        with use_mesh(mesh):
             return nn.unbox(_init_boxed(rng))
 
     init_fn = jax.jit(_init, out_shardings=state_shardings)
@@ -157,7 +158,7 @@ def build_trainer(
         # activation logical-constraints in the models resolve through
         # these rules (no-ops without this context); with-block so a
         # trace-time exception never leaks flax's global rules stack
-        with mesh, nn.logical_axis_rules(rules):
+        with use_mesh(mesh), nn.logical_axis_rules(rules):
             return _train_step_body(state, tokens, targets)
 
     def _train_step_body(state: TrainState, tokens, targets):

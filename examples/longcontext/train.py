@@ -86,6 +86,10 @@ def main(argv=None) -> int:
     if args.seq % seq_shards:
         raise SystemExit(
             f"--seq {args.seq} must divide by seq shards {seq_shards}")
+    if args.hidden < 64 or args.hidden % 64:
+        raise SystemExit(
+            f"--hidden {args.hidden} must be a multiple of 64 "
+            f"(64-dim attention heads)")
     cfg = LlamaConfig(
         vocab_size=1024, hidden_size=args.hidden,
         num_layers=args.layers, num_heads=args.hidden // 64,

@@ -2,6 +2,8 @@
 atorch auto_accelerate_test.py + semi_auto_acc_test.py) — on the 8-device
 virtual CPU mesh from conftest."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -215,11 +217,12 @@ class TestEngine:
         cfg = LlamaConfig.tiny()
         assert info["param_count"] == cfg.param_count()
         assert info["n_devices"] >= 1
-        # fp32 params + fp32 grads + measured adamw moments (mu+nu fp32)
-        # ≈ 16 B/param, plus the optimizer's scalar bookkeeping
+        # fp32 params + transient grads + fp32 grad accumulator +
+        # measured adamw moments (mu+nu fp32) ≈ 20 B/param, plus the
+        # optimizer's scalar bookkeeping
         assert (info["train_state_bytes"]
-                >= info["param_count"] * 16) and (
-            info["train_state_bytes"] < info["param_count"] * 16 + 1024)
+                >= info["param_count"] * 20) and (
+            info["train_state_bytes"] < info["param_count"] * 20 + 1024)
 
     def test_analyse_measures_actual_optimizer_state(self):
         """An adafactor user must not be sized as if they carried fp32
@@ -252,7 +255,7 @@ class TestEngine:
         sizing = size_axes(info)
         # 36/2=18 > 9.6, 36/4=9 <= 9.6 -> fsdp 4, data absorbs the rest
         assert sizing == {"fsdp": 4, "tensor": 1, "sequence": 1,
-                          "data": 2, "remat": False}
+                          "expert": 1, "data": 2, "remat": False}
 
     def test_size_axes_remat_and_tensor_from_activations(self):
         from dlrover_tpu.auto.engine.analyser import size_axes
@@ -296,8 +299,26 @@ class TestEngine:
 
         assert size_axes({"n_devices": 8, "device_hbm_bytes": 0,
                           "train_state_bytes": 1}) == {
-            "fsdp": 1, "tensor": 1, "sequence": 1, "data": 8,
-            "remat": False}
+            "fsdp": 1, "tensor": 1, "sequence": 1, "expert": 1,
+            "data": 8, "remat": False}
+
+    def test_size_axes_expert_for_moe(self):
+        """num_experts > 1 sizes the expert axis: largest divisor of the
+        free devices that divides the expert count — even when HBM is
+        unknown (the axis choice is model-shaped, not memory-shaped)."""
+        from dlrover_tpu.auto.engine.analyser import size_axes
+
+        sizing = size_axes({"n_devices": 8, "device_hbm_bytes": 0,
+                            "train_state_bytes": 1, "num_experts": 4})
+        assert sizing["expert"] == 4 and sizing["data"] == 2
+        gib = 1 << 30
+        sizing = size_axes({"n_devices": 8, "device_hbm_bytes": 16 * gib,
+                            "train_state_bytes": 36 * gib,
+                            "activation_bytes": 0, "num_heads": 16,
+                            "num_kv_heads": 16, "num_experts": 8})
+        # fsdp 4 leaves 2 devices; 2 divides 8 experts -> expert 2
+        assert sizing["fsdp"] == 4 and sizing["expert"] == 2
+        assert sizing["data"] == 1
 
     def test_auto_picks_sized_fsdp_strategy(self, monkeypatch,
                                             cpu_devices):
@@ -332,6 +353,60 @@ class TestEngine:
         tok, tgt = result.trainer.shard_batch(tokens, tokens)
         _, metrics = result.step(state0, tok, tgt)
         assert np.isfinite(float(metrics["loss"]))
+
+    def test_auto_on_moe_picks_expert_axis(self, monkeypatch,
+                                           cpu_devices):
+        """VERDICT round-3 item 4's done bar: auto on an MoE model must
+        pick the expert axis (every candidate carries expert_parallel, so
+        no dry-run race can lose it)."""
+        from dlrover_tpu.models.llama_moe import LlamaMoE, LlamaMoEConfig
+
+        cfg = LlamaMoEConfig.mixtral_tiny(attn_impl="reference")
+        monkeypatch.setenv("DLROVER_TPU_SEARCH_MAX_CANDIDATES", "2")
+        result = auto_accelerate(
+            LlamaMoE(cfg),
+            loss_fn=cross_entropy_loss,
+            sample_batch=np.zeros((2, 16), np.int32),
+            strategy="auto",
+            devices=cpu_devices[:8],
+        )
+        expert_sizes = [conf.get("size") for name, conf in result.strategy
+                        if name == "expert_parallel"]
+        assert expert_sizes and expert_sizes[0] == cfg.num_experts == 4
+        assert result.mesh.shape[MeshAxis.EXPERT] == 4
+        state = result.init(jax.random.PRNGKey(0))
+        batch = result.trainer.accum_steps * result.trainer.micro_batch
+        tokens = np.ones((batch, 16), np.int32)
+        tok, tgt = result.trainer.shard_batch(tokens, tokens)
+        _, metrics = result.step(state, tok, tgt)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_deep_model_gets_sized_pipeline_candidate(self, monkeypatch,
+                                                      cpu_devices):
+        """VERDICT round-3 item 4's second done bar: a deep model that
+        doesn't fit one device gets a SIZED pipeline_parallel candidate
+        in the plan, and the dry-run can score it."""
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(attn_impl="reference"), num_layers=4)
+        state = cfg.param_count() * 20
+        # state doesn't fit one device but fsdp=2 fits
+        monkeypatch.setenv("DLROVER_TPU_HBM_BYTES",
+                           str(int(state / 2 / 0.6) + 1))
+        context = ModelContext(
+            Llama(cfg), optim_factory=lambda lr=1e-3: optax.adamw(lr),
+            loss_fn=cross_entropy_loss,
+            sample_batch=np.zeros((2, 16), np.int32),
+            devices=cpu_devices[:8],
+        )
+        candidates = plan_candidates(context, max_candidates=16)
+        pp = [s for s in candidates
+              if any(n == "pipeline_parallel" for n, _ in s)]
+        assert pp, f"no pipeline candidate in {candidates}"
+        size = next(conf["size"] for n, conf in pp[0]
+                    if n == "pipeline_parallel")
+        assert size in (2, 4) and cfg.num_layers % size == 0
+        speed, err = dry_run(context, pp[0], warmup=1, steps=1)
+        assert err == "" and speed > 0
 
     def test_dry_run_scores_and_survives_bad_strategy(self):
         context = make_context(jax.devices("cpu")[:2])

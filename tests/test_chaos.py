@@ -1,0 +1,94 @@
+"""Chaos injection (reference parity: the chaosblade demo,
+examples/pytorch/mnist/start_chaos.sh): spec grammar, the per-process
+injector, and one scripted chaos run through the real CLI stack."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.diagnostics.chaos import (
+    ChaosInjector,
+    ChaosFault,
+    parse_chaos,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIN = os.path.join(REPO, "examples", "nanogpt", "train.py")
+
+
+class TestChaosSpec:
+    def test_parse_grammar(self):
+        faults = parse_chaos("kill:worker:0@5;hang:worker:1@3:120;"
+                             "slow:ps:2@4:0.5")
+        assert faults[0] == ChaosFault("kill", "worker", 0, 5)
+        assert faults[1] == ChaosFault("hang", "worker", 1, 3, 120.0)
+        assert faults[2] == ChaosFault("slow", "ps", 2, 4, 0.5)
+
+    def test_bad_spec_fails_loudly(self):
+        with pytest.raises(ValueError, match="bad chaos fault"):
+            parse_chaos("kill:worker@5")
+        with pytest.raises(ValueError, match="unknown chaos action"):
+            parse_chaos("explode:worker:0@5")
+
+    def test_injector_filters_role_and_rank(self):
+        inj = ChaosInjector(role="worker", rank=1,
+                            spec="kill:worker:0@5;hang:worker:1@3:0.01")
+        assert [f.action for f in inj.faults] == ["hang"]
+        # unset spec: no faults, no env read surprises
+        assert ChaosInjector(role="worker", rank=0, spec="").faults == []
+
+    def test_hang_fires_once_slow_repeats(self):
+        inj = ChaosInjector(role="worker", rank=0,
+                            spec="hang:worker:0@2:0.05;slow:worker:0@3:0.03")
+        t0 = time.perf_counter()
+        inj.maybe_inject(1)
+        assert time.perf_counter() - t0 < 0.04   # before at_step: no-op
+        t0 = time.perf_counter()
+        inj.maybe_inject(2)
+        assert time.perf_counter() - t0 >= 0.05  # hang fires
+        t0 = time.perf_counter()
+        inj.maybe_inject(2)
+        assert time.perf_counter() - t0 < 0.04   # hang fires ONCE
+        t0 = time.perf_counter()
+        inj.maybe_inject(3)
+        inj.maybe_inject(4)
+        assert time.perf_counter() - t0 >= 0.06  # slow: every step
+
+
+@pytest.mark.e2e
+def test_scripted_chaos_kill_recovers(tmp_path):
+    """The chaos-run twin of the reference's start_chaos.sh: launch the
+    real CLI job with a kill fault armed; the worker SIGKILLs itself at
+    step 3, the agent respawns it, the second incarnation completes the
+    job (resuming from the step-2 checkpoint when its async commit won
+    the race with the kill)."""
+    ckpt = str(tmp_path / "ckpt")
+    log = str(tmp_path / "chaos.log")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # kill fires once per JOB (state dir) at step 3 — one step after the
+    # step-2 checkpoint save kicked off
+    env["DLROVER_TPU_CHAOS"] = "kill:worker:0@3"
+    env["DLROVER_TPU_CHAOS_STATE"] = str(tmp_path / "chaos_state")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.run", "--standalone",
+         "--devices-per-node", "1", "--monitor-interval", "0.2",
+         "--max-restarts", "2",
+         TRAIN, "--steps", "6", "--save-interval", "2",
+         "--global-batch", "8", "--seq", "32",
+         "--ckpt-dir", ckpt, "--log-file", log],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = open(log).read()
+    # exactly two incarnations: the original (killed by the fault) and
+    # one respawn that completes; the fired marker keeps the fault from
+    # replaying into the respawn
+    assert lines.count("start_step=") == 2, lines
+    assert "start_step=0" in lines
+    assert "done step=6" in lines
+    assert os.path.exists(
+        str(tmp_path / "chaos_state" / "chaos_kill_worker_0_3"))

@@ -123,6 +123,112 @@ def test_reshard_on_restore(tiny_setup, cpu_devices, tmp_path):
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_quantized_checkpoint_roundtrip(tiny_setup, cpu_devices, tmp_path):
+    """int8 checkpoint: ~4x fewer payload bytes than the fp32 state, a
+    restored model still trains, and the quantization error is groupwise-
+    bounded (VERDICT r3 item 5: wire ops/quantization into the product)."""
+    import os
+
+    cfg, model, tx = tiny_setup
+    mesh = create_mesh(MeshSpec(fsdp=2), cpu_devices[:2])
+    trainer = _make_trainer(model, tx, mesh)
+    state = trainer.init(jax.random.PRNGKey(2))
+    tokens, targets = _batch(cfg, seed=2)
+    tok, tgt = trainer.shard_batch(tokens, targets)
+    for _ in range(2):
+        state, _ = trainer.step(state, tok, tgt)
+
+    def _dir_bytes(d):
+        return sum(
+            os.path.getsize(os.path.join(root, f))
+            for root, _, files in os.walk(d) for f in files)
+
+    path_q = str(tmp_path / "q")
+    path_raw = str(tmp_path / "raw")
+    with FlashCheckpointer(path_q, save_interval_steps=1,
+                           quantize_bits=8) as ckpt:
+        assert ckpt.maybe_save(2, state, {"pos": 7}, force=True)
+        ckpt.wait()
+    with FlashCheckpointer(path_raw, save_interval_steps=1) as ckpt:
+        assert ckpt.maybe_save(2, state, {"pos": 7}, force=True)
+        ckpt.wait()
+    state_params = jax.tree.map(np.asarray, state.params)
+    abstract = jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                          sharding=leaf.sharding),
+        state,
+    )
+    # (the step donates its input state, so measure the baseline last)
+    baseline_loss = float(trainer.step(state, tok, tgt)[1]["loss"])
+    # payload delta on the PARAMS (what gets quantized — optimizer
+    # moments stay exact; int8 nu wrecks resumed Adam updates): fp32 →
+    # int8 codes + 1/128 fp32 scales ≈ 3.9x. On disk, Orbax metadata
+    # and the exact opt state blunt the ratio at tiny scale.
+    from dlrover_tpu.checkpoint import abstract_encoded, encoded_nbytes
+
+    params_bytes = encoded_nbytes(abstract.params)
+    q_bytes = encoded_nbytes(abstract_encoded(abstract.params, 8))
+    assert q_bytes < params_bytes / 3
+    assert (_dir_bytes(path_q)
+            < _dir_bytes(path_raw) - 0.5 * params_bytes)
+
+    with FlashCheckpointer(path_q) as ckpt:  # detect-from-manifest path
+        restored, data, step = ckpt.restore(abstract)
+    assert step == 2 and data == {"pos": 7}
+    # groupwise int8: per-leaf max error <= absmax(group)/127
+    for a, b in zip(jax.tree.leaves(state_params),
+                    jax.tree.leaves(restored.params)):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        assert np.max(np.abs(a - b)) <= np.max(np.abs(a)) / 127 + 1e-7
+        assert a.shape == b.shape
+    # accuracy impact: the restored model's loss is within noise, and it
+    # keeps training (the step donates `restored`, so one step checks both)
+    new_state, metrics = trainer.step(restored, tok, tgt)
+    loss_q = float(metrics["loss"])
+    assert abs(loss_q - baseline_loss) < 0.05 * abs(baseline_loss) + 1e-3
+    _, metrics2 = trainer.step(new_state, tok, tgt)
+    assert np.isfinite(float(metrics2["loss"]))
+
+
+def test_quantized_reshard_on_restore(tiny_setup, cpu_devices, tmp_path):
+    """Quantized save on 8 devices, restore onto 4 — the codec composes
+    with the elastic-resize reshard path."""
+    cfg, model, tx = tiny_setup
+    mesh8 = create_mesh(MeshSpec(fsdp=2, tensor=2), cpu_devices)
+    trainer8 = _make_trainer(model, tx, mesh8)
+    state = trainer8.init(jax.random.PRNGKey(3))
+    path = str(tmp_path / "ckpt")
+    with FlashCheckpointer(path, save_interval_steps=1,
+                           quantize_bits=8) as ckpt:
+        assert ckpt.maybe_save(1, state, {}, force=True)
+        ckpt.wait()
+    expected = jax.tree.map(np.asarray, state.params)
+    del state, trainer8
+
+    mesh4 = create_mesh(MeshSpec(fsdp=2, tensor=2), cpu_devices[:4])
+
+    def boxed_init(rng):
+        from dlrover_tpu.trainer.train_step import TrainState
+
+        variables = model.init(rng, jnp.zeros((4, 16), jnp.int32))
+        params = variables["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=tx.init(params))
+
+    abstract = abstract_state_for(boxed_init, mesh4, None,
+                                  jax.random.PRNGKey(0))
+    with FlashCheckpointer(path) as ckpt:
+        restored, _, step = ckpt.restore(abstract)
+    assert step == 1
+    for a, b in zip(jax.tree.leaves(expected),
+                    jax.tree.leaves(restored.params)):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        assert np.max(np.abs(a - b)) <= np.max(np.abs(a)) / 127 + 1e-7
+    assert all(
+        set(leaf.sharding.device_set) <= set(cpu_devices[:4])
+        for leaf in jax.tree.leaves(restored.params))
+
+
 def test_interval_gating(tiny_setup, cpu_devices, tmp_path):
     cfg, model, tx = tiny_setup
     mesh = create_mesh(MeshSpec(), cpu_devices[:1])

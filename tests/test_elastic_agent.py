@@ -15,6 +15,10 @@ from dlrover_tpu.agent.elastic_agent import ElasticAgent, WorkerSpec
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.master.job_master import JobMaster
 
+# every test here spawns subprocesses (agents, workers, jax.distributed
+# groups) — minutes-slow; the fast unit core runs with -m "not e2e"
+pytestmark = pytest.mark.e2e
+
 
 @pytest.fixture()
 def master():

@@ -9,6 +9,10 @@ import sys
 
 import pytest
 
+# every test here spawns subprocesses (agents, workers, jax.distributed
+# groups) — minutes-slow; the fast unit core runs with -m "not e2e"
+pytestmark = pytest.mark.e2e
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRAIN = os.path.join(REPO, "examples", "nanogpt", "train.py")
 TRAIN_LONGCTX = os.path.join(REPO, "examples", "longcontext", "train.py")

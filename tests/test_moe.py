@@ -235,23 +235,60 @@ class TestMoEProductPath:
         np.testing.assert_allclose(float(m_again["loss"]), losses[0],
                                    rtol=1e-6)
 
-    def test_moe_config_rejected_by_pipeline_lowering(self):
-        """LlamaMoEConfig subclasses LlamaConfig; the pipeline lowering
-        must refuse it loudly instead of silently pipelining a DENSE
-        Llama built from the MoE dims."""
+    def test_moe_through_pipeline_matches_dense_path(self, cpu_devices):
+        """MoE × pipeline (VERDICT r3 item 7): lower an MoE config onto a
+        pipe × expert mesh and check the pipelined loss equals the
+        single-device dense-path objective (ce + aux) on identical
+        params — experts sharded INSIDE stages, router aux losses carried
+        through the pipeline's aux accumulator."""
         import optax
-        import pytest
 
         from dlrover_tpu.models.llama import cross_entropy_loss
-        from dlrover_tpu.models.llama_moe import LlamaMoEConfig
+        from dlrover_tpu.models.llama_moe import (
+            LlamaMoE,
+            LlamaMoEConfig,
+            moe_cross_entropy_loss,
+        )
         from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
         from dlrover_tpu.trainer.pipeline_trainer import (
             build_pipeline_trainer,
         )
 
-        cfg = LlamaMoEConfig.mixtral_tiny(attn_impl="reference")
-        mesh = create_mesh(MeshSpec(pipe=2), jax.devices("cpu")[:2])
-        with pytest.raises(NotImplementedError, match="MoE"):
-            build_pipeline_trainer(
-                cfg, optax.adam(1e-3), mesh, num_microbatches=2,
-                micro_batch=2, seq_len=16, loss_fn=cross_entropy_loss)
+        cfg = LlamaMoEConfig.mixtral_tiny(attn_impl="reference",
+                                          dtype=jnp.float32)
+        mesh = create_mesh(MeshSpec(pipe=2, expert=2), cpu_devices[:4])
+        tx = optax.sgd(0.0)  # loss comparison only
+        trainer = build_pipeline_trainer(
+            cfg, tx, mesh, num_microbatches=4, micro_batch=2,
+            seq_len=16, loss_fn=cross_entropy_loss)
+        state = trainer.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, cfg.vocab_size, (8, 16), dtype=np.int32)
+        targets = rng.integers(0, cfg.vocab_size, (8, 16), dtype=np.int32)
+        tok, tgt = trainer.shard_batch(tokens, targets)
+        _, metrics = trainer.step(state, tok, tgt)
+        piped_loss = float(metrics["loss"])
+
+        # dense-path oracle with the SAME stacked params, deterministic
+        # routing (the PP spec routes deterministically)
+        params = jax.device_get(trainer.init(
+            jax.random.PRNGKey(0)).params)
+        model = LlamaMoE(cfg, deterministic=True)
+        # rebuild the flax param tree: layer ℓ = chunks[(ℓ // per) dims]
+        per = trainer.layers_per_chunk
+        flat = {}
+        for layer in range(cfg.num_layers):
+            r, rem = divmod(layer, trainer.num_stages * per)
+            s, j = divmod(rem, per)
+            flat[f"layer_{layer}"] = jax.tree.map(
+                lambda leaf: leaf[r, s, j], params["chunks"])
+        dense_params = {
+            "embed": params["shared"]["embed"],
+            "final_norm": {"weight": params["shared"]["final_norm"]},
+            "lm_head": params["shared"]["lm_head"],
+            **flat,
+        }
+        oracle = float(moe_cross_entropy_loss(
+            model, dense_params, jnp.asarray(tokens),
+            jnp.asarray(targets)))
+        np.testing.assert_allclose(piped_loss, oracle, rtol=2e-4)

@@ -5,12 +5,18 @@ coordinator bootstrap (the multi-host story with real process isolation —
 reference analogue: the system tests running master + worker processes
 sharing DLROVER_MASTER_ADDR, SURVEY §4)."""
 
+import pytest
+
 import os
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every test here spawns subprocesses (agents, workers, jax.distributed
+# groups) — minutes-slow; the fast unit core runs with -m "not e2e"
+pytestmark = pytest.mark.e2e
 
 WORKER = """
 from dlrover_tpu.agent.elastic_agent import init_distributed

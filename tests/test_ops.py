@@ -152,7 +152,7 @@ class TestMeshFlashAttention:
             flash_attention,
             mesh_flash_attention,
         )
-        from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
+        from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh, use_mesh
 
         mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2),
                            cpu_devices[:8])
@@ -165,13 +165,13 @@ class TestMeshFlashAttention:
         plain = flash_attention(q, k, v, True)
 
         def sharded_sum(q, k, v):
-            with mesh:
+            with use_mesh(mesh):
                 return jnp.sum(mesh_flash_attention(q, k, v, True) ** 2)
 
         def plain_sum(q, k, v):
             return jnp.sum(flash_attention(q, k, v, True) ** 2)
 
-        with mesh:
+        with use_mesh(mesh):
             sharded = jax.jit(mesh_flash_attention,
                               static_argnums=(3,))(q, k, v, True)
         np.testing.assert_allclose(np.asarray(sharded), np.asarray(plain),

@@ -40,6 +40,45 @@ class TestMeshSpec:
         with pytest.raises(ValueError):
             create_mesh(MeshSpec(tensor=3), cpu_devices)
 
+    def test_mesh_covers_devices_once(self, cpu_devices):
+        """Topology assignment may permute device order but must place
+        every device exactly once with the spec'd axis sizes."""
+        mesh = create_mesh(MeshSpec(fsdp=2, tensor=2), cpu_devices)
+        assert sorted(d.id for d in mesh.devices.flat) == sorted(
+            d.id for d in cpu_devices)
+        assert mesh.shape[MeshAxis.FSDP] == 2
+        assert mesh.shape[MeshAxis.TENSOR] == 2
+
+    def test_dcn_split_prefers_data_then_pipe(self):
+        from dlrover_tpu.parallel.mesh import _dcn_split
+
+        # 2 granules land on the data axis when it divides
+        spec = MeshSpec(data=4, tensor=2)
+        sizes = [name for name, _ in spec.axis_sizes()]
+        dcn = _dcn_split(spec, 2)
+        assert dcn is not None and dcn[sizes.index(MeshAxis.DATA)] == 2
+        # data=1: falls through to pipe
+        spec = MeshSpec(data=1, pipe=4, tensor=2)
+        dcn = _dcn_split(spec, 2)
+        assert dcn is not None and dcn[sizes.index(MeshAxis.PIPE)] == 2
+        # nothing divides: None (caller falls back + warns)
+        assert _dcn_split(MeshSpec(data=3, pipe=1), 2) is None
+
+
+class TestAmbientMesh:
+    def test_use_mesh_nests_and_restores(self, cpu_devices):
+        from dlrover_tpu.parallel.mesh import current_mesh, use_mesh
+
+        m1 = create_mesh(MeshSpec(data=8), cpu_devices)
+        m2 = create_mesh(MeshSpec(data=4), cpu_devices[:4])
+        assert current_mesh() is None
+        with use_mesh(m1):
+            assert current_mesh() is m1
+            with use_mesh(m2):
+                assert current_mesh() is m2
+            assert current_mesh() is m1
+        assert current_mesh() is None
+
 
 class TestChooseAccumulation:
     def test_fits_without_accum(self):

@@ -229,6 +229,70 @@ class TestPipelinedTrainer:
         captured = capfd.readouterr()
         assert "Involuntary full rematerialization" not in captured.err
 
+    def test_bert_pipeline_matches_dense(self, cpu_devices):
+        """Encoder (BERT) pipeline spec (VERDICT r3 item 8): the MLM
+        objective through the pipeline equals the dense Bert forward on
+        identical params."""
+        from dlrover_tpu.models.bert import Bert, BertConfig, mlm_loss
+
+        cfg = BertConfig.tiny(attn_impl="reference", dtype=jnp.float32)
+        mesh = create_mesh(MeshSpec(data=2, pipe=2), cpu_devices[:4])
+        trainer = build_pipeline_trainer(
+            cfg, optax.sgd(0.0), mesh, num_microbatches=4,
+            micro_batch=2, seq_len=16, loss_fn=mlm_loss)
+        state = trainer.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(3)
+        tokens = rng.integers(0, cfg.vocab_size, (8, 16), dtype=np.int32)
+        targets = rng.integers(0, cfg.vocab_size, (8, 16), dtype=np.int32)
+        tok, tgt = trainer.shard_batch(tokens, targets)
+        _, metrics = trainer.step(state, tok, tgt)
+        piped = float(metrics["loss"])
+
+        params = jax.device_get(trainer.init(jax.random.PRNGKey(0)).params)
+        per = trainer.layers_per_chunk
+        flat = {}
+        for layer in range(cfg.num_layers):
+            r, rem = divmod(layer, trainer.num_stages * per)
+            s, j = divmod(rem, per)
+            flat[f"layer_{layer}"] = jax.tree.map(
+                lambda leaf: leaf[r, s, j], params["chunks"])
+        dense_params = {
+            **params["shared"], **flat,
+            # the segment table is a fine-tuning feature the pipeline
+            # spec omits; zeros = the token_types=None path regardless
+            "type_embed": np.zeros(
+                (cfg.type_vocab_size, cfg.hidden_size), np.float32),
+        }
+        logits = Bert(cfg).apply({"params": dense_params},
+                                 jnp.asarray(tokens))
+        oracle = float(mlm_loss(logits, jnp.asarray(targets)))
+        np.testing.assert_allclose(piped, oracle, rtol=2e-4)
+
+    def test_offload_opt_state_shardings(self, cpu_devices):
+        """offload_optimizer × pipeline (VERDICT r3 item 8): optimizer
+        moments carry pinned_host shardings; scalars and params stay in
+        device memory. (Mixed-memory-kind EXECUTION is TPU-only, same
+        contract as the dense trainer's offload test.)"""
+        cfg = LlamaConfig.tiny(attn_impl="reference", dtype=jnp.float32)
+        mesh = create_mesh(MeshSpec(data=2, pipe=2), cpu_devices[:4])
+        trainer = build_pipeline_trainer(
+            cfg, optax.adam(1e-3), mesh, num_microbatches=4,
+            micro_batch=2, seq_len=16, loss_fn=flat_loss,
+            offload_opt_state=True)
+        trainer._ensure_shardings(jax.random.PRNGKey(0))
+        shardings = trainer.state_shardings
+        abstract = jax.eval_shape(trainer._make_state,
+                                  jax.random.PRNGKey(0))
+        kinds = {
+            s.memory_kind
+            for s, leaf in zip(jax.tree.leaves(shardings.opt_state),
+                               jax.tree.leaves(abstract.opt_state))
+            if leaf.ndim > 0
+        }
+        assert kinds == {"pinned_host"}
+        assert all(s.memory_kind == "device"
+                   for s in jax.tree.leaves(shardings.params))
+
     def test_indivisible_layers_rejected(self, cpu_devices):
         mesh = create_mesh(MeshSpec(pipe=4), cpu_devices[:4])
         cfg = LlamaConfig.tiny()  # 2 layers, 4 stages
