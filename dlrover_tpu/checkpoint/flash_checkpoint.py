@@ -78,7 +78,6 @@ class FlashCheckpointer:
         self._directory = directory
         self._save_interval = save_interval_steps
         self._quantize_bits = quantize_bits
-        self._encoder = None
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             enable_async_checkpointing=True,
@@ -103,22 +102,25 @@ class FlashCheckpointer:
             from dlrover_tpu.checkpoint.quantized import encode_tree
 
             bits = self._quantize_bits
-            if self._encoder is None:
-                # cache the jitted encoder: a fresh lambda every save
-                # would retrace + recompile over the full state at every
-                # checkpoint interval
-                if hasattr(state, "params") and hasattr(state, "replace"):
-                    # PARAMS only: int8 on Adam's second moments wrecks
-                    # the resumed update (sqrt(nu) denominators amplify
-                    # the groupwise error — measured: post-resume loss
-                    # 2x worse); params carry the bulk of the bytes
-                    self._encoder = jax.jit(lambda s: s.replace(
-                        params=encode_tree(s.params, bits)))
-                else:
-                    self._encoder = jax.jit(
-                        lambda s: encode_tree(s, bits))
-            state = self._encoder(state)
-            data_state[_QUANT_KEY] = bits
+            # encode_tree dispatches small per-leaf jitted programs
+            # (cached across saves); PARAMS only — int8 on Adam's second
+            # moments wrecks the resumed update (sqrt(nu) denominators
+            # amplify the groupwise error; measured: post-resume loss 2x
+            # worse), and params carry the bulk of the bytes anyway
+            if hasattr(state, "params") and hasattr(state, "replace"):
+                state = state.replace(
+                    params=encode_tree(state.params, bits))
+                data_state[_QUANT_KEY] = bits
+            elif isinstance(state, dict) and "params" in state:
+                state = {**state, "params": encode_tree(
+                    state["params"], bits)}
+                data_state[_QUANT_KEY] = bits
+            else:
+                # no identifiable params subtree: quantizing blindly
+                # would hit optimizer moments — save exact instead
+                logger.warning(
+                    "quantize_bits=%d requested but the state has no "
+                    "'params' subtree; saving exact dtypes", bits)
         with self._lock:
             args = ocp.args.Composite(**{
                 _MODEL_ITEM: ocp.args.StandardSave(state),
@@ -153,22 +155,30 @@ class FlashCheckpointer:
                 decode_tree,
             )
 
-            params_only = (hasattr(abstract_state, "params")
-                           and hasattr(abstract_state, "replace"))
-            if params_only:
+            if hasattr(abstract_state, "params") and hasattr(
+                    abstract_state, "replace"):
                 target = abstract_state.replace(
                     params=abstract_encoded(abstract_state.params, bits))
-            else:
-                target = abstract_encoded(abstract_state, bits)
-            encoded = self._manager.restore(
-                step, args=ocp.args.Composite(**{
-                    _MODEL_ITEM: ocp.args.StandardRestore(target)}),
-            )[_MODEL_ITEM]
-            if params_only:
+                encoded = self._manager.restore(
+                    step, args=ocp.args.Composite(**{
+                        _MODEL_ITEM: ocp.args.StandardRestore(target)}),
+                )[_MODEL_ITEM]
                 state = encoded.replace(params=decode_tree(
                     encoded.params, abstract_state.params, bits))
+            elif (isinstance(abstract_state, dict)
+                  and "params" in abstract_state):
+                target = {**abstract_state, "params": abstract_encoded(
+                    abstract_state["params"], bits)}
+                encoded = self._manager.restore(
+                    step, args=ocp.args.Composite(**{
+                        _MODEL_ITEM: ocp.args.StandardRestore(target)}),
+                )[_MODEL_ITEM]
+                state = {**encoded, "params": decode_tree(
+                    encoded["params"], abstract_state["params"], bits)}
             else:
-                state = decode_tree(encoded, abstract_state, bits)
+                raise ValueError(
+                    "quantized checkpoint but the restore target has no "
+                    "'params' subtree to decode into")
         else:
             state = self._manager.restore(
                 step, args=ocp.args.Composite(**{

@@ -30,6 +30,7 @@ restore sides always agree on the tree structure.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import jax
@@ -114,18 +115,36 @@ def _dequantize_leaf(node: dict, target: Any, bits: int,
     return out.reshape(target.shape)
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_quantizer(bits: int, group_size: int, mode: str):
+    return jax.jit(functools.partial(
+        _quantize_leaf, bits=bits, group_size=group_size, mode=mode))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_dequantizer(bits: int, group_size: int, mode: str,
+                        shape, dtype):
+    target = jax.ShapeDtypeStruct(shape, dtype)
+    return jax.jit(lambda q, s: _dequantize_leaf(
+        {_TAG: bits, "q": q, "s": s}, target, bits, group_size, mode))
+
+
 def encode_tree(state: Any, bits: int = 8,
                 group_size: int = DEFAULT_GROUP) -> Any:
-    """Quantize eligible leaves; jit-compatible (call under jit to run
-    shard-local on a mesh)."""
+    """Quantize eligible leaves on device, one small jitted program per
+    unique (shape, mode) — NOT one whole-tree program: a mega-program
+    with hundreds of big-tensor outputs is exactly the compile that
+    stalls remote-compile backends (observed wedging the axon tunnel),
+    and the per-leaf programs hit jit's cache across leaves and saves."""
     if bits not in (8, 4):
         raise ValueError(f"checkpoint quantization bits must be 8 or 4, "
                          f"got {bits}")
+
     def _leaf(leaf):
         mode = _mode(leaf, group_size)
         if mode == "raw":
             return leaf
-        return _quantize_leaf(leaf, bits, group_size, mode)
+        return _jitted_quantizer(bits, group_size, mode)(leaf)
 
     return jax.tree.map(_leaf, state)
 
@@ -176,7 +195,9 @@ def abstract_encoded(abstract_state: Any, bits: int = 8,
 
 def decode_tree(encoded: Any, abstract_state: Any, bits: int = 8,
                 group_size: int = DEFAULT_GROUP) -> Any:
-    """Dequantize back into the abstract state's dtypes + shardings."""
+    """Dequantize back into the abstract state's dtypes + shardings —
+    per-leaf jitted programs (see encode_tree), with each result
+    device_put into the target's sharding when one is given."""
     enc_leaves = jax.tree.leaves(encoded, is_leaf=_is_encoded)
     targets, treedef = jax.tree.flatten(abstract_state)
     assert len(enc_leaves) == len(targets), (
@@ -184,20 +205,21 @@ def decode_tree(encoded: Any, abstract_state: Any, bits: int = 8,
         f"{len(targets)} — quantization eligibility drifted between "
         f"save and restore")
 
-    def _decode(pairs):
-        return [
-            _dequantize_leaf(node, target, bits, group_size,
-                             _mode(target, group_size))
-            if _is_encoded(node) else jnp.asarray(node, target.dtype)
-            for node, target in zip(pairs, targets)
-        ]
-
-    shardings = [getattr(t, "sharding", None) for t in targets]
-    if all(isinstance(s, NamedSharding) for s in shardings):
-        decode = jax.jit(_decode, out_shardings=shardings)
-    else:
-        decode = jax.jit(_decode)
-    return jax.tree.unflatten(treedef, decode(enc_leaves))
+    out = []
+    for node, target in zip(enc_leaves, targets):
+        if _is_encoded(node):
+            mode = _mode(target, group_size)
+            fn = _jitted_dequantizer(bits, group_size, mode,
+                                     tuple(target.shape),
+                                     jnp.dtype(target.dtype))
+            leaf = fn(node["q"], node["s"])
+        else:
+            leaf = jnp.asarray(node, target.dtype)
+        sharding = getattr(target, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            leaf = jax.device_put(leaf, sharding)
+        out.append(leaf)
+    return jax.tree.unflatten(treedef, out)
 
 
 def encoded_nbytes(encoded: Any) -> int:

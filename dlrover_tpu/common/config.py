@@ -25,6 +25,9 @@ class Context:
         self.task_timeout_s: float = DefaultValues.TASK_TIMEOUT_S
         self.heartbeat_interval_s: float = DefaultValues.HEARTBEAT_INTERVAL_S
         self.hang_seconds: float = DefaultValues.HANG_SECONDS
+        self.dead_node_timeout_s: float = (
+            DefaultValues.DEAD_NODE_TIMEOUT_S
+        )
         self.max_relaunch: int = DefaultValues.MAX_RELAUNCH
         self.kv_wait_timeout_s: float = DefaultValues.KV_WAIT_TIMEOUT_S
         self.monitor_interval_s: float = DefaultValues.MONITOR_INTERVAL_S

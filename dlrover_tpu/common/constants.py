@@ -144,6 +144,11 @@ class DefaultValues:
     TASK_TIMEOUT_S = 1800.0
     HEARTBEAT_INTERVAL_S = 15.0
     HANG_SECONDS = 1800.0
+    # an agent silent this long is declared dead: its rendezvous world is
+    # invalidated so survivors re-form (the scale-DOWN path). Liveness is
+    # touched by join/get_comm_world/num_nodes_waiting RPCs — any healthy
+    # agent beats far faster than this.
+    DEAD_NODE_TIMEOUT_S = 90.0
     MAX_RELAUNCH = 3
     GRPC_MAX_MESSAGE_MB = 64
     KV_WAIT_TIMEOUT_S = 300.0

@@ -133,9 +133,12 @@ def _probe_round(client: MasterClient, devices_per_node: int,
     # Round 1 re-runs the same probe program in a fresh process; a shared
     # persistent compile cache lets it skip the cold compile that makes a
     # loaded 1-core host starve the coordination-service deadline.
+    import getpass
+
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(tempfile.gettempdir(),
-                                "dlrover_tpu_nc_cache"))
+                                f"dlrover_tpu_nc_cache_"
+                                f"{getpass.getuser()}"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     t0 = time.perf_counter()
     try:

@@ -62,6 +62,12 @@ class RendezvousManager:
         # every one of them re-joins or dies — a survivor whose poll missed
         # the first window must still be told to restart.
         self._pending_rejoin: set = set()
+        # rank -> last RPC touch (join / comm-world / waiting-num polls):
+        # the liveness source for reap_dead_nodes in topologies with no
+        # node manager (standalone/CLI masters — reference analogue: the
+        # torch rendezvous backend expiring silent members,
+        # elastic_agent/torch/training.py:483-521)
+        self._last_seen: Dict[int, float] = {}
 
     # -- membership (driven by the node manager / event callbacks) --------
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
@@ -75,6 +81,31 @@ class RendezvousManager:
     def add_alive_node(self, node_rank: int) -> None:
         with self._lock:
             self._alive_nodes.add(node_rank)
+            self._last_seen[node_rank] = time.time()
+
+    def touch(self, node_rank: int) -> None:
+        """Record liveness for a rank (any agent RPC qualifies)."""
+        if node_rank < 0:
+            return
+        with self._lock:
+            self._last_seen[node_rank] = time.time()
+
+    def reap_dead_nodes(self, timeout_s: float) -> None:
+        """Declare ranks silent for > timeout_s dead (world invalidation
+        via remove_alive_node). 0/negative disables. Runs on live agents'
+        polls — no master thread needed, and with no live agents there is
+        nobody left to tell anyway."""
+        if timeout_s <= 0:
+            return
+        now = time.time()
+        with self._lock:
+            dead = [rank for rank in self._alive_nodes
+                    if now - self._last_seen.get(rank, now) > timeout_s]
+        for rank in dead:
+            logger.warning(
+                "%s rendezvous: node %d silent for > %.0fs; declaring it "
+                "dead", self.name, rank, timeout_s)
+            self.remove_alive_node(rank, graceful=False)
 
     def remove_alive_node(self, node_rank: int,
                           graceful: bool = False) -> None:
@@ -113,6 +144,7 @@ class RendezvousManager:
             self._waiting[node_rank] = _WaitingNode(node_rank,
                                                     local_world_size)
             self._alive_nodes.add(node_rank)
+            self._last_seen[node_rank] = time.time()
             self._pending_rejoin.discard(node_rank)
             if node_ip:
                 self._node_ips[node_rank] = node_ip
@@ -125,6 +157,7 @@ class RendezvousManager:
         """Poll for the completed world. Returns (round, group, world) —
         empty world while the round is still forming."""
         with self._lock:
+            self._last_seen[node_rank] = time.time()
             if self._check_rdzv_completed():
                 self._cut_round()
             # A node still in the waiting list has re-joined for the NEXT
@@ -228,6 +261,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
     def get_comm_world(self, node_rank: int
                        ) -> Tuple[int, int, Dict[int, int]]:
         with self._lock:
+            self._last_seen[node_rank] = time.time()
             if self._check_rdzv_completed():
                 self._cut_round()
                 self._groups[self._rdzv_round - 1] = self._group_nodes(

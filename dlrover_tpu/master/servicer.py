@@ -93,6 +93,12 @@ class MasterServicer:
                                  round=rdzv_round, group=group, world=world)
         if isinstance(request, msg.WaitingNodeNumRequest):
             mgr = self.rdzv_managers[request.rdzv_name]
+            # the steady-state poll every live agent makes: liveness
+            # touch + dead-member reaping ride on it, so agent death is
+            # detected even with no node manager (standalone masters)
+            mgr.touch(request.node_id)
+            mgr.reap_dead_nodes(
+                Context.singleton().dead_node_timeout_s)
             return msg.WaitingNodeNum(waiting_num=mgr.num_nodes_waiting())
         if isinstance(request, msg.KVGetRequest):
             return msg.KeyValuePair(key=request.key,

@@ -581,12 +581,12 @@ def build_pipeline_trainer(cfg: Union[LlamaConfig, GPTConfig],
     mean over its batch rows (cross_entropy_loss qualifies). The pipeline
     applies it per microbatch row and averages — a sum-reducing loss
     would silently change scale vs the dense trainer."""
-    if (jax.default_backend() != "tpu"
+    if (jax.default_backend() == "cpu"
             and jnp.dtype(cfg.dtype) in (jnp.bfloat16, jnp.float16)):
         # XLA's CPU backend CHECK-fails (AllReducePromotion: "Invalid
         # binary instruction opcode copy") compiling the pipeline's
         # half-precision collectives; fp32 keeps CPU dry-runs/tests
-        # alive and TPU runs are unaffected.
+        # alive. Only the CPU backend — TPU/GPU handle bf16 collectives.
         from dlrover_tpu.common.log import default_logger as logger
 
         logger.info("pipeline trainer: forcing fp32 compute on the %s "

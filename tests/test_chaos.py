@@ -40,22 +40,22 @@ class TestChaosSpec:
         # unset spec: no faults, no env read surprises
         assert ChaosInjector(role="worker", rank=0, spec="").faults == []
 
-    def test_hang_fires_once_slow_repeats(self):
+    def test_hang_fires_once_slow_repeats(self, monkeypatch):
+        from dlrover_tpu.diagnostics import chaos as chaos_mod
+
+        sleeps = []
+        monkeypatch.setattr(chaos_mod.time, "sleep", sleeps.append)
         inj = ChaosInjector(role="worker", rank=0,
-                            spec="hang:worker:0@2:0.05;slow:worker:0@3:0.03")
-        t0 = time.perf_counter()
+                            spec="hang:worker:0@2:5.0;slow:worker:0@3:0.5")
         inj.maybe_inject(1)
-        assert time.perf_counter() - t0 < 0.04   # before at_step: no-op
-        t0 = time.perf_counter()
+        assert sleeps == []                      # before at_step: no-op
         inj.maybe_inject(2)
-        assert time.perf_counter() - t0 >= 0.05  # hang fires
-        t0 = time.perf_counter()
+        assert sleeps == [5.0]                   # hang fires
         inj.maybe_inject(2)
-        assert time.perf_counter() - t0 < 0.04   # hang fires ONCE
-        t0 = time.perf_counter()
+        assert sleeps == [5.0]                   # hang fires ONCE
         inj.maybe_inject(3)
         inj.maybe_inject(4)
-        assert time.perf_counter() - t0 >= 0.06  # slow: every step
+        assert sleeps == [5.0, 0.5, 0.5]         # slow: every step
 
 
 @pytest.mark.e2e

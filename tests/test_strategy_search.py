@@ -38,12 +38,24 @@ class TestFeaturize:
         assert not np.allclose(a, c)
 
     def test_axis_sizes_enter_log2(self):
+        from dlrover_tpu.auto.engine.sg_algo import _OVERFLOW, _SIZED_SLOTS
+
+        base = _OVERFLOW + 1
         a = featurize(strat(fsdp=8))
         b = featurize(strat(fsdp=2))
-        assert a[-2] == pytest.approx(3.0)
-        assert b[-2] == pytest.approx(1.0)
+        assert a[base + _SIZED_SLOTS["fsdp"]] == pytest.approx(3.0)
+        assert b[base + _SIZED_SLOTS["fsdp"]] == pytest.approx(1.0)
         t = featurize(strat(tensor=4))
-        assert t[-1] == pytest.approx(2.0)
+        assert t[base + _SIZED_SLOTS["tensor_parallel"]] == \
+            pytest.approx(2.0)
+        # every sized axis gets its own slot: candidates differing only
+        # in a sequence/expert/pipe size must featurize differently
+        s = featurize([("sequence_parallel", {"size": 4})])
+        s2 = featurize([("sequence_parallel", {"size": 8})])
+        assert not np.array_equal(s, s2)
+        e = featurize([("expert_parallel", {"size": 4})])
+        p = featurize([("pipeline_parallel", {"size": 4})])
+        assert not np.array_equal(e, p)
 
     def test_unknown_pass_hits_overflow_slot(self):
         x = featurize([("made_up_pass", {})])
