@@ -78,6 +78,36 @@ def probe_tpu(timeout_s: float = 120.0) -> bool:
         return False
 
 
+def _run_json_subprocess(cmd, timeout_s: float, env=None) -> dict:
+    """Run cmd in its own process group, parse the last JSON line of
+    stdout. On timeout the whole group is SIGKILLed (a worker grandchild
+    may hold the single-client accelerator tunnel). Returns {"error":
+    ...} on any failure."""
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True, env=env,
+    )
+    try:
+        stdout, _ = proc.communicate(timeout=timeout_s)
+        for line in reversed(stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return {"error": f"timed out after {timeout_s}s"}
+    except Exception as e:
+        return {"error": str(e)[:200]}
+    return {"error": "no result line"}
+
+
 def run_restore_bench(timeout_s: float = 480.0,
                       at_scale: bool = False) -> float:
     """Run bench_restore.py in a subprocess tree. The toy mode is
@@ -87,10 +117,6 @@ def run_restore_bench(timeout_s: float = 480.0,
     --at-scale mode runs the 1.47B bench model ON the chip (multi-GB
     restore + re-jit, VERDICT r3 item 1); it must run while no other
     process holds the TPU. Returns seconds, or -1.0 on failure."""
-    import subprocess
-
-    import signal
-
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_restore.py")
     env = dict(os.environ)
@@ -99,29 +125,11 @@ def run_restore_bench(timeout_s: float = 480.0,
         cmd.append("--at-scale")
     else:
         env["JAX_PLATFORMS"] = "cpu"
-    # Own process group: on timeout the agent's worker grandchild (which
-    # holds the accelerator) must die too, or the main bench can't claim
-    # the chip afterwards.
-    proc = subprocess.Popen(
-        cmd,
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        start_new_session=True, env=env,
-    )
+    result = _run_json_subprocess(cmd, timeout_s + 60, env=env)
     try:
-        stdout, _ = proc.communicate(timeout=timeout_s + 60)
-        for line in reversed(stdout.splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                return float(json.loads(line)["value"])
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except OSError:
-            pass
-        proc.wait()
-    except Exception:
-        pass
-    return -1.0
+        return float(result["value"])
+    except (KeyError, TypeError, ValueError):
+        return -1.0
 
 
 def seven_b_main() -> int:
@@ -184,55 +192,13 @@ def seven_b_main() -> int:
 def run_7b_bench(timeout_s: float = 900.0) -> dict:
     """Run the --llama7b attempt in its own process (it must own the
     TPU; a failure must not kill the headline bench)."""
-    import signal
-    import subprocess
-
-    proc = subprocess.Popen(
+    return _run_json_subprocess(
         [sys.executable, os.path.abspath(__file__), "--llama7b"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        start_new_session=True,
-    )
-    try:
-        stdout, _ = proc.communicate(timeout=timeout_s)
-        for line in reversed(stdout.splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                return json.loads(line)
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except OSError:
-            pass
-        proc.wait()
-        return {"error": f"timed out after {timeout_s}s"}
-    except Exception as e:
-        return {"error": str(e)[:200]}
-    return {"error": "no result line"}
+        timeout_s)
 
 
-def main() -> None:
-    from dlrover_tpu.agent.elastic_agent import apply_jax_platform_env
-
-    apply_jax_platform_env()   # JAX_PLATFORMS=cpu must win on dev machines
-    skip_restore = os.environ.get("BENCH_SKIP_RESTORE") == "1"
-    restore_s = -1.0 if skip_restore else run_restore_bench()
-    tpu_unreachable = False
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and not probe_tpu():
-        # wedged tunnel: degrade to CPU so the bench reports instead of
-        # hanging the driver
-        tpu_unreachable = True
-        jax.config.update("jax_platforms", "cpu")
-    # TPU-owning subprocess phases run BEFORE this process initializes
-    # the backend: the tunnel serves exactly one client at a time.
-    want_tpu = (os.environ.get("JAX_PLATFORMS", "") != "cpu"
-                and not tpu_unreachable)
-    restore_scale_s = -1.0
-    llama7b: dict = {}
-    if want_tpu and not skip_restore:
-        restore_scale_s = run_restore_bench(timeout_s=900.0,
-                                            at_scale=True)
-    if want_tpu and os.environ.get("BENCH_SKIP_7B") != "1":
-        llama7b = run_7b_bench()
+def _measure() -> dict:
+    """The headline measurement (owns the accelerator in THIS process)."""
     on_tpu = jax.default_backend() == "tpu"
     # Factored second moments (adafactor family) keep the optimizer
     # state out of HBM so the chip fits a model big enough to saturate
@@ -329,11 +295,82 @@ def main() -> None:
         6.0 * cfg.num_layers * cfg.hidden_size * seq
     )
     mfu = tokens_per_sec * flops_per_token / peak_flops(jax.devices()[0])
+    return {
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "mfu": round(mfu, 4),
+        "params_b": round(cfg.param_count() / 1e9, 2),
+        "seq": seq,
+        "opt": opt_name,
+        "on_tpu": on_tpu,
+    }
+
+
+def measure_main() -> int:
+    """--measure subprocess: the headline measurement, isolated so a
+    later TPU-owning phase (at-scale restore, 7B attempt) that wedges
+    the tunnel can never take the headline metric down with it."""
+    from dlrover_tpu.agent.elastic_agent import apply_jax_platform_env
+
+    apply_jax_platform_env()
+    print(json.dumps(_measure()))
+    return 0
+
+
+def run_measure_bench(timeout_s: float = 900.0) -> dict:
+    return _run_json_subprocess(
+        [sys.executable, os.path.abspath(__file__), "--measure"],
+        timeout_s)
+
+
+def main() -> None:
+    from dlrover_tpu.agent.elastic_agent import apply_jax_platform_env
+
+    apply_jax_platform_env()   # JAX_PLATFORMS=cpu must win on dev machines
+    skip_restore = os.environ.get("BENCH_SKIP_RESTORE") == "1"
+    restore_s = -1.0 if skip_restore else run_restore_bench()
+    tpu_unreachable = False
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and not probe_tpu():
+        # wedged tunnel: degrade to CPU so the bench reports instead of
+        # hanging the driver
+        tpu_unreachable = True
+        jax.config.update("jax_platforms", "cpu")
+    want_tpu = (os.environ.get("JAX_PLATFORMS", "") != "cpu"
+                and not tpu_unreachable)
+    restore_scale_s = -1.0
+    llama7b: dict = {}
+    if want_tpu:
+        # every TPU phase runs in its OWN subprocess (the tunnel serves
+        # one client at a time), headline FIRST — later riskier phases
+        # re-probe and are skipped if the tunnel wedged
+        headline = run_measure_bench()
+        if "error" in headline:
+            tpu_unreachable = True
+            jax.config.update("jax_platforms", "cpu")
+            headline = _measure()
+        # the at-scale restore and 7B phases are TPU-only: on a dev
+        # machine the headline subprocess reports on_tpu=False (probing
+        # devices alone can't tell — CPU devices probe fine)
+        if headline.get("on_tpu"):
+            if not skip_restore and probe_tpu():
+                restore_scale_s = run_restore_bench(timeout_s=900.0,
+                                                    at_scale=True)
+            if os.environ.get("BENCH_SKIP_7B") != "1":
+                if probe_tpu():
+                    llama7b = run_7b_bench()
+                else:
+                    llama7b = {"error": "tunnel unreachable after "
+                                        "earlier phase"}
+    else:
+        headline = _measure()   # CPU fallback, in-process
+
+    tokens_per_sec = headline["tokens_per_sec"]
+    mfu = headline["mfu"]
     result = {
         "metric": "llama_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": f"tokens/s ({cfg.param_count()/1e9:.2f}B params, "
-                f"seq {seq}, {opt_name}, MFU {mfu:.3f}, "
+        "value": tokens_per_sec,
+        "unit": f"tokens/s ({headline['params_b']:.2f}B params, "
+                f"seq {headline['seq']}, {headline['opt']}, "
+                f"MFU {mfu:.3f}, "
                 + (f"elastic_restore {restore_s:.1f}s vs <30s target)"
                    if restore_s >= 0 else "elastic_restore skipped)"),
         "vs_baseline": round(mfu / 0.40, 3),
@@ -356,4 +393,6 @@ def main() -> None:
 if __name__ == "__main__":
     if "--llama7b" in sys.argv:
         raise SystemExit(seven_b_main())
+    if "--measure" in sys.argv:
+        raise SystemExit(measure_main())
     main()

@@ -138,6 +138,16 @@ class MasterClient:
         ), msg.JoinRendezvousResult)
         return result.round
 
+    @retry_rpc()
+    def leave_rendezvous(self, rdzv_name: str = RendezvousName.TRAINING
+                         ) -> bool:
+        """Withdraw from an uncompleted round (poll deadline gave up)."""
+        return self._report(msg.LeaveRendezvousRequest(
+            node_id=self.node_id,
+            node_rank=self.node_rank,
+            rdzv_name=rdzv_name,
+        )).success
+
     @retry_rpc(retries=3)
     def get_comm_world(self, rdzv_name: str = RendezvousName.TRAINING
                        ) -> Tuple[int, int, Dict[int, int]]:

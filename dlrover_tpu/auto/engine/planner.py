@@ -75,10 +75,13 @@ def _sized_candidates(info, n_devices: int) -> List[Strategy]:
                             not sizing["remat"], sizing["sequence"],
                             sizing["expert"]))
     # depth-sharded alternative: pipeline stages instead of fsdp, the
-    # remaining devices on data — the dry-run arbitrates
+    # remaining devices on data; MoE configs compose the expert axis
+    # INSIDE stages (pipeline_trainer's MoE spec) — the dry-run
+    # arbitrates either way
     pipe = _pipeline_size(info, n_devices)
-    if pipe > 1 and not info.get("num_experts", 0):
-        candidates.append(build(1, 1, sizing["remat"], 1, 1, pipe))
+    expert = sizing["expert"]
+    if pipe > 1 and n_devices % (pipe * expert) == 0:
+        candidates.append(build(1, 1, sizing["remat"], 1, expert, pipe))
     return candidates
 
 
@@ -119,9 +122,13 @@ def plan_candidates(context: ModelContext,
 
     extras: List[Strategy] = []
     pipe = _pipeline_size(info, n_devices)
-    if pipe > 1 and sizing["expert"] <= 1:
-        extras.append([("half", {}), ("module_replace", {}),
-                       ("pipeline_parallel", {"size": pipe})])
+    if pipe > 1 and n_devices % (pipe * sizing["expert"]) == 0:
+        extra: Strategy = [("half", {}), ("module_replace", {})]
+        if sizing["expert"] > 1:
+            extra.append(("expert_parallel",
+                          {"size": sizing["expert"]}))
+        extra.append(("pipeline_parallel", {"size": pipe}))
+        extras.append(extra)
     if not info["fits_one_device"]:
         # host-offloaded optimizer state: the single-device escape hatch
         # (and an fsdp alternative the dry-run can score)

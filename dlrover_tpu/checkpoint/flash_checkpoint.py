@@ -176,9 +176,14 @@ class FlashCheckpointer:
                 state = {**encoded, "params": decode_tree(
                     encoded["params"], abstract_state["params"], bits)}
             else:
-                raise ValueError(
-                    "quantized checkpoint but the restore target has no "
-                    "'params' subtree to decode into")
+                # legacy whole-tree layout (or a custom pytree with no
+                # params subtree): decode every encoded node in place
+                target = abstract_encoded(abstract_state, bits)
+                encoded = self._manager.restore(
+                    step, args=ocp.args.Composite(**{
+                        _MODEL_ITEM: ocp.args.StandardRestore(target)}),
+                )[_MODEL_ITEM]
+                state = decode_tree(encoded, abstract_state, bits)
         else:
             state = self._manager.restore(
                 step, args=ocp.args.Composite(**{

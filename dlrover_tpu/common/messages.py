@@ -185,6 +185,17 @@ class JoinRendezvousResult(Message):
 
 
 @dataclass
+class LeaveRendezvousRequest(Message):
+    """A joiner abandoning an uncompleted round (poll deadline). Without
+    this, its stale entry lets a late partner complete a round with a
+    peer that already gave up."""
+
+    node_id: int = -1
+    node_rank: int = -1
+    rdzv_name: str = ""
+
+
+@dataclass
 class WaitingNodeNumRequest(Message):
     node_id: int = -1
     rdzv_name: str = ""

@@ -112,14 +112,28 @@ def _probe_round(client: MasterClient, devices_per_node: int,
         if world and client.node_rank in world:
             break
         if time.time() > deadline:
+            # withdraw the stale join: a late partner must not complete
+            # this round against a peer that already gave up (it would
+            # hang waiting for a coordinator that never publishes)
+            client.leave_rendezvous(rdzv)
             return False, 0.0
         time.sleep(0.5)
 
     ranks = sorted(world)
     process_id = ranks.index(client.node_rank)
-    coord = publish_or_wait_coordinator(
-        client, f"coord/{rdzv}/{rdzv_round}/{group}", process_id, timeout_s,
-    )
+    try:
+        coord = publish_or_wait_coordinator(
+            client, f"coord/{rdzv}/{rdzv_round}/{group}", process_id,
+            timeout_s,
+        )
+    except TimeoutError:
+        # the pair's rank 0 never published (it may have abandoned the
+        # round under load): this ROUND failed for us; the verdict layer
+        # decides faultiness from both rounds
+        logger.warning("network check: no coordinator for round %d "
+                       "group %d; counting the round as failed",
+                       rdzv_round, group)
+        return False, 0.0
 
     with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
         result_file = f.name
@@ -133,12 +147,11 @@ def _probe_round(client: MasterClient, devices_per_node: int,
     # Round 1 re-runs the same probe program in a fresh process; a shared
     # persistent compile cache lets it skip the cold compile that makes a
     # loaded 1-core host starve the coordination-service deadline.
-    import getpass
-
+    # per-user cache dir (uid, not getpass: containers with no passwd
+    # entry for an arbitrary uid raise KeyError from getpass.getuser())
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(tempfile.gettempdir(),
-                                f"dlrover_tpu_nc_cache_"
-                                f"{getpass.getuser()}"))
+                                f"dlrover_tpu_nc_cache_{os.getuid()}"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     t0 = time.perf_counter()
     try:

@@ -152,6 +152,19 @@ class RendezvousManager:
                 self._latest_round_start = time.time()
             return self._rdzv_round
 
+    def leave_waiting(self, node_rank: int) -> None:
+        """A joiner abandoning an UNCOMPLETED round (its poll deadline
+        expired). Its entry must not linger: a late partner would
+        otherwise complete the round against a peer that already left
+        and hang waiting for that peer's coordinator. The node stays
+        alive (it may re-join); a no-op after the round cut."""
+        with self._lock:
+            if self._waiting.pop(node_rank, None) is not None:
+                logger.info(
+                    "%s rendezvous: node %d left the waiting list "
+                    "(gave up on the forming round)", self.name,
+                    node_rank)
+
     def get_comm_world(self, node_rank: int
                        ) -> Tuple[int, int, Dict[int, int]]:
         """Poll for the completed world. Returns (round, group, world) —

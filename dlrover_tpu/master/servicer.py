@@ -165,6 +165,9 @@ class MasterServicer:
             rdzv_round = mgr.join_rendezvous(
                 request.node_rank, request.local_world_size, request.node_ip)
             return msg.JoinRendezvousResult(round=rdzv_round)
+        elif isinstance(request, msg.LeaveRendezvousRequest):
+            mgr = self.rdzv_managers[request.rdzv_name]
+            mgr.leave_waiting(request.node_rank)
         elif isinstance(request, msg.NetworkStatusReport):
             mgr = self.rdzv_managers[RendezvousName.NETWORK_CHECK]
             mgr.report_network_status(request.node_id, request.normal,

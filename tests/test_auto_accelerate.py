@@ -408,6 +408,36 @@ class TestEngine:
         speed, err = dry_run(context, pp[0], warmup=1, steps=1)
         assert err == "" and speed > 0
 
+    def test_moe_deep_model_gets_expert_pipe_candidate(self, monkeypatch,
+                                                       cpu_devices):
+        """A deep MoE model that doesn't fit one device plans an
+        expert × pipeline composition (experts sharded INSIDE stages —
+        the reference's 3D story) and the dry-run can score it."""
+        from dlrover_tpu.models.llama_moe import LlamaMoE, LlamaMoEConfig
+
+        cfg = dataclasses.replace(
+            LlamaMoEConfig.mixtral_tiny(attn_impl="reference"),
+            num_layers=4)
+        state = cfg.param_count() * 20
+        monkeypatch.setenv("DLROVER_TPU_HBM_BYTES",
+                           str(int(state / 2 / 0.6) + 1))
+        context = ModelContext(
+            LlamaMoE(cfg), optim_factory=lambda lr=1e-3: optax.adamw(lr),
+            loss_fn=cross_entropy_loss,
+            sample_batch=np.zeros((2, 16), np.int32),
+            devices=cpu_devices[:8],
+        )
+        candidates = plan_candidates(context, max_candidates=16)
+        combo = [s for s in candidates
+                 if any(n == "pipeline_parallel" for n, _ in s)
+                 and any(n == "expert_parallel" for n, _ in s)]
+        assert combo, candidates
+        sizes = dict((n, c.get("size")) for n, c in combo[0])
+        assert (sizes["expert_parallel"] * sizes["pipeline_parallel"]
+                <= 8)
+        speed, err = dry_run(context, combo[0], warmup=1, steps=1)
+        assert err == "" and speed > 0
+
     def test_dry_run_scores_and_survives_bad_strategy(self):
         context = make_context(jax.devices("cpu")[:2])
         speed, err = dry_run(context, [("half", {})], warmup=1, steps=2)

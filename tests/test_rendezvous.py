@@ -267,6 +267,29 @@ class TestRendezvousOverflow:
         mgr.reap_dead_nodes(timeout_s=10.0)
         assert 0 in mgr._alive_nodes
 
+    def test_leave_waiting_withdraws_abandoned_join(self):
+        """A joiner that gives up polling an uncompleted round must be
+        able to withdraw: its stale entry would otherwise let a LATE
+        partner complete the round against a peer that already left and
+        hang waiting for that peer's coordinator (the network-check
+        flake's root cause under load)."""
+        mgr = make_mgr(2, 2, wait=3600.0)
+        mgr.join_rendezvous(0, 1)
+        # node 0's poll deadline expires; it withdraws
+        mgr.leave_waiting(0)
+        # node 1 arrives late: the round must NOT complete with node 0
+        mgr.join_rendezvous(1, 1)
+        _, _, world = mgr.get_comm_world(1)
+        assert world == {}
+        # node 0 re-joins -> the round completes for real
+        mgr.join_rendezvous(0, 1)
+        _, _, world = mgr.get_comm_world(1)
+        assert sorted(world) == [0, 1]
+        # leaving after the cut is a no-op (the world stands)
+        mgr.leave_waiting(0)
+        _, _, world = mgr.get_comm_world(1)
+        assert sorted(world) == [0, 1]
+
     def test_graceful_exit_keeps_world_valid(self):
         """A node finishing cleanly must NOT invalidate the world: the
         survivors are finishing their own work and must not be told to
