@@ -36,6 +36,10 @@ _MODEL_ITEM = "state"
 _DATA_ITEM = "data"
 # data-item key marking a quantized state payload (and its bit width)
 _QUANT_KEY = "_ckpt_quantized_bits"
+# which subtree was encoded: "params" (current saves) or "tree" (legacy
+# whole-state layout). Checkpoints missing this key predate it: their
+# save quantized params-only iff the state had a .params ATTRIBUTE.
+_QUANT_LAYOUT_KEY = "_ckpt_quantized_layout"
 
 
 def abstract_state_for(init_fn, mesh, rules=None, *args) -> Any:
@@ -111,10 +115,12 @@ class FlashCheckpointer:
                 state = state.replace(
                     params=encode_tree(state.params, bits))
                 data_state[_QUANT_KEY] = bits
+                data_state[_QUANT_LAYOUT_KEY] = "params"
             elif isinstance(state, dict) and "params" in state:
                 state = {**state, "params": encode_tree(
                     state["params"], bits)}
                 data_state[_QUANT_KEY] = bits
+                data_state[_QUANT_LAYOUT_KEY] = "params"
             else:
                 # no identifiable params subtree: quantizing blindly
                 # would hit optimizer moments — save exact instead
@@ -155,34 +161,40 @@ class FlashCheckpointer:
                 decode_tree,
             )
 
-            if hasattr(abstract_state, "params") and hasattr(
-                    abstract_state, "replace"):
-                target = abstract_state.replace(
-                    params=abstract_encoded(abstract_state.params, bits))
-                encoded = self._manager.restore(
+            # the SAVED layout decides the decode shape — not the restore
+            # target's: a checkpoint without the layout key predates it,
+            # and its save quantized params-only iff the state had a
+            # .params attribute (legacy dict states were whole-tree)
+            layout = data.pop(_QUANT_LAYOUT_KEY, "")
+            if not layout:
+                layout = ("params" if hasattr(abstract_state, "params")
+                          else "tree")
+
+            def _restore_encoded(target):
+                return self._manager.restore(
                     step, args=ocp.args.Composite(**{
                         _MODEL_ITEM: ocp.args.StandardRestore(target)}),
                 )[_MODEL_ITEM]
+
+            if layout == "params" and hasattr(abstract_state, "params") \
+                    and hasattr(abstract_state, "replace"):
+                encoded = _restore_encoded(abstract_state.replace(
+                    params=abstract_encoded(abstract_state.params,
+                                            bits)))
                 state = encoded.replace(params=decode_tree(
                     encoded.params, abstract_state.params, bits))
-            elif (isinstance(abstract_state, dict)
+            elif (layout == "params"
+                  and isinstance(abstract_state, dict)
                   and "params" in abstract_state):
-                target = {**abstract_state, "params": abstract_encoded(
-                    abstract_state["params"], bits)}
-                encoded = self._manager.restore(
-                    step, args=ocp.args.Composite(**{
-                        _MODEL_ITEM: ocp.args.StandardRestore(target)}),
-                )[_MODEL_ITEM]
+                encoded = _restore_encoded(
+                    {**abstract_state, "params": abstract_encoded(
+                        abstract_state["params"], bits)})
                 state = {**encoded, "params": decode_tree(
                     encoded["params"], abstract_state["params"], bits)}
             else:
-                # legacy whole-tree layout (or a custom pytree with no
-                # params subtree): decode every encoded node in place
-                target = abstract_encoded(abstract_state, bits)
-                encoded = self._manager.restore(
-                    step, args=ocp.args.Composite(**{
-                        _MODEL_ITEM: ocp.args.StandardRestore(target)}),
-                )[_MODEL_ITEM]
+                # whole-tree layout: decode every encoded node in place
+                encoded = _restore_encoded(
+                    abstract_encoded(abstract_state, bits))
                 state = decode_tree(encoded, abstract_state, bits)
         else:
             state = self._manager.restore(
