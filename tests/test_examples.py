@@ -16,6 +16,7 @@ pytestmark = pytest.mark.e2e
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRAIN = os.path.join(REPO, "examples", "nanogpt", "train.py")
 TRAIN_LONGCTX = os.path.join(REPO, "examples", "longcontext", "train.py")
+TRAIN_MOE = os.path.join(REPO, "examples", "moe", "train.py")
 
 
 def run_cli(tmp_path, extra, timeout=240, script=TRAIN):
@@ -145,6 +146,40 @@ def test_longcontext_ring_attention_standalone(tmp_path):
         "--hidden", "128", "--layers", "2",
         "--ckpt-dir", ckpt, "--log-file", log2,
     ], script=TRAIN_LONGCTX, timeout=360)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = open(log2).read()
+    assert "start_step=4" in lines
+    assert "done step=6" in lines
+
+
+def test_moe_expert_parallel_standalone(tmp_path):
+    """The MoE example through the real CLI: expert-sharded mesh (4 of
+    the virtual CPU devices), router aux losses through the standard
+    trainer, checkpoint commit, then a resumed run continuing from the
+    saved step."""
+    ckpt = str(tmp_path / "ckpt")
+    log1 = str(tmp_path / "run1.log")
+    proc = run_cli(tmp_path, [
+        "--steps", "4", "--save-interval", "2",
+        "--global-batch", "8", "--seq", "64",
+        "--experts", "4", "--expert-shards", "4",
+        "--hidden", "64", "--layers", "2",
+        "--ckpt-dir", ckpt, "--log-file", log1,
+    ], script=TRAIN_MOE, timeout=360)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = open(log1).read()
+    assert "start_step=0" in lines and "expert_shards=4" in lines
+    assert "done step=4" in lines
+    assert os.path.isdir(ckpt) and os.listdir(ckpt)
+
+    log2 = str(tmp_path / "run2.log")
+    proc = run_cli(tmp_path, [
+        "--steps", "6", "--save-interval", "2",
+        "--global-batch", "8", "--seq", "64",
+        "--experts", "4", "--expert-shards", "4",
+        "--hidden", "64", "--layers", "2",
+        "--ckpt-dir", ckpt, "--log-file", log2,
+    ], script=TRAIN_MOE, timeout=360)
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = open(log2).read()
     assert "start_step=4" in lines
