@@ -72,6 +72,7 @@ def worker_main(ckpt_dir: str, events_file: str, total_steps: int,
                 at_scale: bool = False) -> int:
     from dlrover_tpu.agent.elastic_agent import init_distributed
 
+    _emit(events_file, {"event": "worker_start", "pid": os.getpid()})
     init_distributed()   # applies JAX_PLATFORMS + joins the process set
 
     import jax
@@ -120,7 +121,35 @@ def worker_main(ckpt_dir: str, events_file: str, total_steps: int,
     )
     loop.install_signal_handler()
     state, start = loop.restore_or_init(jax.random.PRNGKey(0))
-    _emit(events_file, {"event": "restored", "step": start})
+    _emit(events_file, {"event": "restored", "step": start,
+                        "timings": loop.last_restore_timings})
+
+    restored_start = start
+    if start > 0:
+        # instrument the FIRST post-restore step in detail: dispatch
+        # (includes any inline re-jit the AOT path failed to avoid) vs
+        # force (execution + any deferred transfer)
+        rng0 = np.random.default_rng(start)
+        tokens = rng0.integers(0, cfg.vocab_size,
+                               (global_batch, seq_len), dtype=np.int32)
+        t0 = time.perf_counter()
+        tok, tgt = loop.trainer.shard_batch(tokens, tokens)
+        t1 = time.perf_counter()
+        state, metrics = loop.trainer.step(state, tok, tgt)
+        t2 = time.perf_counter()
+        float(metrics["loss"])
+        t3 = time.perf_counter()
+        start += 1
+        _emit(events_file, {
+            "event": "step", "step": start,
+            "restored_from": restored_start,
+            "first_step_detail": {
+                "shard_batch_s": round(t1 - t0, 2),
+                "dispatch_s": round(t2 - t1, 2),
+                "force_s": round(t3 - t2, 2),
+                "aot_used": getattr(loop.trainer, "last_used_aot",
+                                    None),
+            }})
 
     rng = np.random.default_rng(start)
     step = start
@@ -132,7 +161,7 @@ def worker_main(ckpt_dir: str, events_file: str, total_steps: int,
         state, _ = loop.run(state, [(tokens, targets)], start_step=step)
         step += 1
         _emit(events_file, {"event": "step", "step": step,
-                            "restored_from": start})
+                            "restored_from": restored_start})
         if loop._stop_requested.is_set():
             break
     loop.close()
@@ -242,8 +271,9 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
                 None),
             "first step after restore",
         )
+        events = _read_events(events_file)
         restored = next(
-            e for e in _read_events(events_file)
+            e for e in events
             if e["event"] == "restored" and e["t"] > t_kill)
         elapsed = first["t"] - t_kill
         ckpt_bytes = 0
@@ -251,11 +281,30 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
         for root, _, files in os.walk(step_dir):
             ckpt_bytes += sum(
                 os.path.getsize(os.path.join(root, f)) for f in files)
+        # per-phase breakdown of the kill -> first-step window: detect/
+        # respawn (kill -> worker_start), jax + loop build (worker_start
+        # -> restore phases, from the worker's own timings), first step
+        breakdown = dict(restored.get("timings") or {})
+        respawn = next(
+            (e for e in events
+             if e["event"] == "worker_start" and e["t"] > t_kill), None)
+        if respawn is not None:
+            breakdown["detect_respawn_s"] = round(
+                respawn["t"] - t_kill, 2)
+            measured = sum(
+                v for k, v in breakdown.items()
+                if k in ("abstract_state_s", "orbax_read_s",
+                         "device_ready_s", "compile_wait_after_read_s"))
+            breakdown["loop_build_s"] = round(
+                restored["t"] - respawn["t"] - measured, 2)
+        breakdown["first_step_s"] = round(first["t"] - restored["t"], 2)
+        breakdown.update(first.get("first_step_detail") or {})
         return {
             "elastic_restore_seconds": round(elapsed, 2),
             "restored_step": restored["step"],
             "first_step_after_restore": first["step"],
             "checkpoint_gb": round(ckpt_bytes / (1 << 30), 2),
+            "breakdown": breakdown,
         }
     finally:
         agent.shutdown()
@@ -288,6 +337,8 @@ def main() -> int:
                  f"restore step {result['restored_step']} "
                  f"[{result['checkpoint_gb']} GB] -> first step; 1 host)"),
         "vs_baseline": round(30.0 / max(seconds, 1e-9), 2),
+        "breakdown": result.get("breakdown", {}),
+        "checkpoint_gb": result["checkpoint_gb"],
     }))
     return 0
 

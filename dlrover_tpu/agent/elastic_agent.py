@@ -56,6 +56,22 @@ class WorkerSpec:
     # --relaunch_on_hanging analog)
     relaunch_on_hanging: bool = False
 
+    def __post_init__(self) -> None:
+        # THIS interval (not Context.monitor_interval_s, an independent
+        # master-side knob) paces the agent's num_nodes_waiting poll —
+        # the master's main liveness signal. A dead-node timeout under
+        # ~3 polls reaps healthy agents that merely missed one tick.
+        from dlrover_tpu.common.config import Context
+
+        timeout = Context.singleton().dead_node_timeout_s
+        if 0 < timeout < 3 * self.monitor_interval_s:
+            logger.warning(
+                "dead_node_timeout_s (%.0fs) < 3x the agent poll "
+                "interval (--monitor-interval %.0fs): healthy agents "
+                "may be declared dead between polls; raise the timeout "
+                "or lower the poll interval",
+                timeout, self.monitor_interval_s)
+
 
 class RendezvousTimeoutError(TimeoutError):
     pass

@@ -209,6 +209,7 @@ class MasterClient:
     def report_global_step(self, step: int) -> bool:
         return self._report(msg.GlobalStepReport(
             node_id=self.node_id, step=step, timestamp=time.time(),
+            node_rank=self.node_rank,
         )).success
 
     def report_resource_stats(self, stats: msg.NodeResourceStats) -> bool:
@@ -217,7 +218,7 @@ class MasterClient:
     def report_heartbeat(self) -> bool:
         return self._report(msg.NodeHeartbeat(
             node_id=self.node_id, node_type=self.node_type,
-            timestamp=time.time())).success
+            timestamp=time.time(), node_rank=self.node_rank)).success
 
     def report_failure(self, error_data: str, level: str,
                        restart_count: int = 0) -> bool:

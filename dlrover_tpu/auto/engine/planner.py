@@ -146,7 +146,9 @@ def plan_candidates(context: ModelContext,
                 candidates.append(strategy)
                 if len(candidates) >= max_candidates:
                     return candidates
-        if size == 1:
+        # after the singles round — or right after the baseline when there
+        # are no optional passes at all (extras must still be planned)
+        if size == min(1, len(optional)):
             for strategy in extras:
                 if strategy not in candidates:
                     candidates.append(strategy)

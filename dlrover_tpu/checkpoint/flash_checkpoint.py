@@ -162,13 +162,21 @@ class FlashCheckpointer:
             )
 
             # the SAVED layout decides the decode shape — not the restore
-            # target's: a checkpoint without the layout key predates it,
-            # and its save quantized params-only iff the state had a
-            # .params attribute (legacy dict states were whole-tree)
+            # target's. Checkpoints written before the layout key existed
+            # carry only the quant marker: their save quantized
+            # params-only iff the state had a .params attribute, so infer
+            # that rule from the restore target — loudly, because on a
+            # corrupted data item the inference can be wrong (a wrong
+            # guess fails the decode's leaf-count/shape checks rather
+            # than restoring silently corrupt state).
             layout = data.pop(_QUANT_LAYOUT_KEY, "")
             if not layout:
                 layout = ("params" if hasattr(abstract_state, "params")
                           else "tree")
+                logger.warning(
+                    "checkpoint step %s: quantized marker without %s "
+                    "(legacy save); inferring layout=%r from the restore "
+                    "target", step, _QUANT_LAYOUT_KEY, layout)
 
             def _restore_encoded(target):
                 return self._manager.restore(

@@ -310,6 +310,9 @@ class NodeHeartbeat(Message):
     node_id: int = -1
     node_type: str = ""
     timestamp: float = 0.0
+    # rendezvous liveness is keyed by RANK (node_id diverges from rank
+    # after a relaunch, run.py); -1 = sender predates the field
+    node_rank: int = -1
 
 
 @dataclass
@@ -324,6 +327,7 @@ class GlobalStepReport(Message):
     node_id: int = -1
     step: int = 0
     timestamp: float = 0.0
+    node_rank: int = -1        # see NodeHeartbeat.node_rank
 
 
 @dataclass

@@ -114,8 +114,16 @@ def _probe_round(client: MasterClient, devices_per_node: int,
         if time.time() > deadline:
             # withdraw the stale join: a late partner must not complete
             # this round against a peer that already gave up (it would
-            # hang waiting for a coordinator that never publishes)
-            client.leave_rendezvous(rdzv)
+            # hang waiting for a coordinator that never publishes).
+            # Best-effort: a master hiccup here must stay a round
+            # failure, not escalate into an exception that fails the
+            # whole health check.
+            try:
+                client.leave_rendezvous(rdzv)
+            except Exception:
+                logger.warning("network check: leave_rendezvous failed; "
+                               "continuing with round failure",
+                               exc_info=True)
             return False, 0.0
         time.sleep(0.5)
 

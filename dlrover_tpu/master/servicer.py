@@ -180,6 +180,7 @@ class MasterServicer:
         elif isinstance(request, msg.GlobalStepReport):
             self.speed_monitor.collect_worker_step(request.node_id,
                                                    request.step)
+            self._touch_rendezvous(request.node_rank)
         elif isinstance(request, msg.NodeResourceStats):
             if self.job_manager is not None:
                 self.job_manager.update_node_resource_usage(request)
@@ -190,6 +191,7 @@ class MasterServicer:
                 self.job_manager.collect_heartbeat(
                     request.node_id, request.timestamp,
                     node_type=request.node_type)
+            self._touch_rendezvous(request.node_rank)
         elif isinstance(request, msg.NodeFailureReport):
             logger.warning("node %d failure (level=%s): %s",
                            request.node_id, request.level,
@@ -229,6 +231,17 @@ class MasterServicer:
                            type(request).__name__)
             ok, reason = False, "unknown request"
         return msg.Response(success=ok, reason=reason)
+
+    # ------------------------------------------------------------------
+    def _touch_rendezvous(self, node_rank: int) -> None:
+        """Liveness must not depend on the num_nodes_waiting poll alone:
+        heartbeats and step reports carry the sender's RANK (the key the
+        rendezvous alive-set uses; node_id diverges from rank after a
+        relaunch), so they count as liveness too. Otherwise a user-raised
+        --monitor-interval near dead_node_timeout_s gets healthy agents
+        reaped mid-training. touch() ignores rank < 0 (legacy senders)."""
+        for mgr in self.rdzv_managers.values():
+            mgr.touch(node_rank)
 
     # ------------------------------------------------------------------
     def _get_job_status(self) -> msg.JobStatus:

@@ -187,8 +187,26 @@ def use_mesh(mesh: Mesh):
 
 
 def current_mesh() -> Optional[Mesh]:
-    """The ambient mesh set by :func:`use_mesh`, or None."""
-    return _AMBIENT_MESH.get()
+    """The ambient mesh set by :func:`use_mesh`, falling back to jax's
+    own mesh context (a bare ``with mesh:``) so external callers using
+    the documented jax idiom still get sequence-parallel dispatch and
+    the flash-attention shard_map wrapper."""
+    mesh = _AMBIENT_MESH.get()
+    if mesh is not None:
+        return mesh
+    try:
+        # A bare `with mesh:` registers only in jax's thread resources;
+        # read them defensively — the attribute is not public API, and
+        # losing the fallback on a jax upgrade must degrade to "no
+        # ambient mesh", not crash.
+        from jax._src import mesh as _jax_mesh  # noqa: PLC0415
+
+        ambient = _jax_mesh.thread_resources.env.physical_mesh
+        if ambient is not None and not ambient.empty:
+            return ambient
+    except Exception:
+        pass
+    return None
 
 
 def data_axes(mesh: Mesh) -> Tuple[str, ...]:

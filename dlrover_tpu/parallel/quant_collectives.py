@@ -1,0 +1,121 @@
+"""Quantized gradient all-reduce: int8/int4 codes on the wire.
+
+Capability parity: the reference's quant_reduce CUDA kernel dequantizes
+N swizzled partitions, reduces them, and requantizes the result for the
+wire (atorch/atorch/ops/csrc/quantization/quant_reduce.cu:248, bound at
+pt_binding.cpp:178) — the communication half of its quantization suite,
+built for the slow (inter-node / DCN) gradient all-reduce. TPU
+re-design: the same groupwise-symmetric scheme rides XLA collectives
+inside a shard_map that is manual ONLY over the reduce axis (the
+data/DCN axis — `_dcn_split` in parallel/mesh.py routes exactly this
+axis across the slow fabric), so intra-slice sharding stays auto:
+
+- ``scatter`` mode (the quant_reduce analog): each member quantizes its
+  local gradient per chunk, all_to_alls the codes, dequantizes the N
+  received versions of its own chunk, reduces, REquantizes, and
+  all_gathers the reduced codes. Wire bytes ≈ 2x the quantized payload
+  — ~4x less than a bf16 ring all-reduce, ~8x less than fp32.
+- ``gather`` mode (small N): one quantization, all_gather codes+scales,
+  dequantize-and-sum locally. Cheaper than scatter for N <= 4 and
+  single-quantization (half the rounding error).
+
+Accuracy: groupwise int8 keeps per-group relative error ~= 1/(2*127);
+the end-to-end training-impact bound lives in
+tests/test_quant_allreduce.py (loss-curve comparison vs the exact
+reduce).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.ops.quantization import pack_int4, unpack_int4
+
+DEFAULT_GROUP = 256
+# below this many elements the quantization bookkeeping costs more than
+# the wire savings — psum exact
+MIN_QUANT_SIZE = 2048
+
+
+def _quantize(x2: jax.Array, qmax: int):
+    absmax = jnp.max(jnp.abs(x2), axis=-1, keepdims=True)
+    scale = absmax / qmax
+    inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
+    q = jnp.clip(jnp.round(x2 * inv), -qmax, qmax).astype(jnp.int8)
+    return q, scale
+
+
+def _wire_encode(q: jax.Array, bits: int) -> jax.Array:
+    return pack_int4(q) if bits == 4 else q
+
+
+def _wire_decode(q: jax.Array, bits: int) -> jax.Array:
+    return unpack_int4(q) if bits == 4 else q
+
+
+def quantized_pmean_leaf(g: jax.Array, axis_name: str, n: int,
+                         bits: int = 8,
+                         group_size: int = DEFAULT_GROUP,
+                         mode: str = "auto") -> jax.Array:
+    """Mean-reduce one gradient leaf over ``axis_name`` with quantized
+    wire traffic. Must run inside a shard_map manual over ``axis_name``.
+    """
+    if (not jnp.issubdtype(g.dtype, jnp.floating)
+            or g.size < MIN_QUANT_SIZE):
+        return lax.pmean(g, axis_name)
+    qmax = 127 if bits == 8 else 7
+    if mode == "auto":
+        mode = "gather" if n <= 4 else "scatter"
+
+    flat = g.reshape(-1).astype(jnp.float32)
+    # pad so groups (and in scatter mode, the n chunks) divide evenly
+    quantum = group_size * (n if mode == "scatter" else 1)
+    pad = (-flat.shape[0]) % quantum
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+
+    if mode == "gather":
+        x2 = flat.reshape(-1, group_size)
+        q, s = _quantize(x2, qmax)
+        qg = lax.all_gather(_wire_encode(q, bits), axis_name)
+        sg = lax.all_gather(s, axis_name)
+        deq = _wire_decode(qg, bits).astype(jnp.float32) * sg
+        out = jnp.sum(deq, axis=0) / n
+    else:
+        # chunk i of my gradient goes to member i; I become the reducer
+        # for my own chunk index (quant_reduce.cu's partition layout)
+        x3 = flat.reshape(n, -1, group_size)
+        q, s = _quantize(x3, qmax)
+        qt = lax.all_to_all(_wire_encode(q, bits), axis_name,
+                            split_axis=0, concat_axis=0, tiled=False)
+        st = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)
+        # (n, groups, group): n members' versions of MY chunk
+        chunk = jnp.sum(
+            _wire_decode(qt, bits).astype(jnp.float32) * st, axis=0) / n
+        # requantize the reduced chunk for the gather leg
+        q2, s2 = _quantize(chunk, qmax)
+        qg = lax.all_gather(_wire_encode(q2, bits), axis_name)
+        sg = lax.all_gather(s2, axis_name)
+        out = (_wire_decode(qg, bits).astype(jnp.float32) * sg)
+    out = out.reshape(-1)
+    if pad:
+        out = out[:g.size]
+    return out.astype(g.dtype).reshape(g.shape)
+
+
+def quantized_pmean(tree: Any, axis_name: str, n: int, bits: int = 8,
+                    group_size: int = DEFAULT_GROUP,
+                    mode: str = "auto") -> Any:
+    """Tree-wise quantized mean over a manual mesh axis."""
+    if bits not in (8, 4):
+        raise ValueError(f"grad-reduce bits must be 8 or 4, got {bits}")
+    fn = functools.partial(quantized_pmean_leaf, axis_name=axis_name,
+                           n=n, bits=bits, group_size=group_size,
+                           mode=mode)
+    return jax.tree.map(fn, tree)

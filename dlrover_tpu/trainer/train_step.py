@@ -60,6 +60,10 @@ class ShardedTrainer:
     batch_sharding: NamedSharding
     accum_steps: int
     micro_batch: int
+    batch_abstract: Optional[jax.ShapeDtypeStruct] = None
+    _compiled_step: Any = dataclasses.field(default=None, repr=False)
+    precompile_timings: dict = dataclasses.field(default_factory=dict)
+    last_used_aot: bool = False
 
     def init(self, rng: jax.Array) -> TrainState:
         return self.init_fn(rng)
@@ -70,7 +74,51 @@ class ShardedTrainer:
         return abstract_state_with_shardings(
             jax.eval_shape(self.init_fn, rng), self.state_shardings)
 
+    def precompile(self, rng: Optional[jax.Array] = None) -> None:
+        """AOT-compile the train step from abstract inputs (trace +
+        lower + XLA compile or persistent-cache load), so a respawned
+        worker can overlap compilation with its checkpoint read instead
+        of serializing re-jit after it (the measured ~155 s tail of the
+        262 s at-scale restore, docs/benchmarks.md). Safe to call from a
+        background thread; `step` uses the compiled executable when
+        present and falls back to the jitted path on any mismatch."""
+        if self._compiled_step is not None or self.batch_abstract is None:
+            return
+        import time as _time
+
+        abstract = self.abstract_state(
+            jax.random.PRNGKey(0) if rng is None else rng)
+        t0 = _time.monotonic()
+        lowered = self.step_fn.lower(
+            abstract, self.batch_abstract, self.batch_abstract)
+        t1 = _time.monotonic()
+        compiled = lowered.compile()
+        t2 = _time.monotonic()
+        self.precompile_timings = {
+            "trace_lower_s": round(t1 - t0, 2),
+            "compile_or_cache_load_s": round(t2 - t1, 2),
+        }
+        self._compiled_step = compiled
+
     def step(self, state: TrainState, tokens, targets):
+        if self._compiled_step is not None:
+            try:
+                out = self._compiled_step(state, tokens, targets)
+                self.last_used_aot = True
+                return out
+            except (TypeError, ValueError) as e:
+                # pre-dispatch signature/layout mismatch vs the AOT
+                # arguments (raised before buffers are donated): the
+                # jitted path recompiles correctly. Runtime errors (OOM,
+                # XlaRuntimeError) propagate — state may already be
+                # donated, so re-running would only mask the real error.
+                from dlrover_tpu.common.log import default_logger
+
+                default_logger.warning(
+                    "AOT-compiled step rejected its arguments (%s); "
+                    "falling back to the jitted path", e)
+                self._compiled_step = None
+        self.last_used_aot = False
         return self.step_fn(state, tokens, targets)
 
     def shard_batch(self, tokens, targets):
@@ -95,6 +143,8 @@ def build_trainer(
     donate_state: bool = True,
     offload_opt_state: bool = False,
     rng_seed: int = 0,
+    grad_reduce_bits: int = 0,
+    grad_reduce_axis: str = MeshAxis.DATA,
 ) -> ShardedTrainer:
     """Lower (model, optimizer, mesh) into init/step programs.
 
@@ -107,6 +157,14 @@ def build_trainer(
     moments' shardings carry the host memory kind and XLA inserts the
     host↔HBM transfers around the update, freeing ~2/3 of the train
     state's HBM at the cost of PCIe/DMA traffic per step.
+
+    grad_reduce_bits: 8/4 = the gradient mean over ``grad_reduce_axis``
+    (the data axis — the one `_dcn_split` routes across the slow DCN
+    fabric on multi-slice jobs) runs through the quantized collective
+    (parallel/quant_collectives.py, the reference quant_reduce.cu
+    analog) instead of XLA's implicit fp psum: the whole step is wrapped
+    in a shard_map manual over that one axis, every other axis stays
+    auto. 0 = exact reduce (default).
     """
     rules = list(rules if rules is not None else DEFAULT_RULES)
 
@@ -161,7 +219,8 @@ def build_trainer(
         with use_mesh(mesh), nn.logical_axis_rules(rules):
             return _train_step_body(state, tokens, targets)
 
-    def _train_step_body(state: TrainState, tokens, targets):
+    def _train_step_body(state: TrainState, tokens, targets,
+                         grad_reduce=None):
         params = state.params
         # Deterministic per-step rng streams for stochastic model paths
         # (MoE gating jitter, dropout): folded from the step counter so
@@ -199,6 +258,11 @@ def build_trainer(
             micro_step, (jnp.zeros((), jnp.float32), zero_grads),
             (tokens, targets, jnp.arange(accum_steps)),
         )
+        if grad_reduce is not None:
+            # explicit (quantized) mean over the manual reduce axis; the
+            # loss metric reduces exactly (it's a scalar)
+            grad_sum = grad_reduce(grad_sum)
+            loss_sum = jax.lax.pmean(loss_sum, grad_reduce_axis)
         grads = jax.tree.map(
             lambda g, p: (g / accum_steps).astype(p.dtype), grad_sum, params
         )
@@ -212,8 +276,56 @@ def build_trainer(
         }
         return new_state, metrics
 
+    n_reduce = mesh.shape.get(grad_reduce_axis, 1)
+    if grad_reduce_bits and n_reduce > 1:
+        from jax.sharding import PartitionSpec
+        from jax import shard_map
+
+        from dlrover_tpu.parallel.quant_collectives import quantized_pmean
+
+        # Manual ONLY over the reduce axis: every other axis (fsdp/tp/…)
+        # stays auto so XLA keeps intra-slice sharding + collectives.
+        # Activation rules must not name the manual axis — strip it.
+        def _strip(axes):
+            if axes is None:
+                return None
+            if isinstance(axes, str):
+                return None if axes == grad_reduce_axis else axes
+            kept = tuple(a for a in axes if a != grad_reduce_axis)
+            return kept or None
+
+        rules_local = [(name, _strip(axes)) for name, axes in rules]
+
+        def _reduce(tree):
+            return quantized_pmean(tree, grad_reduce_axis, n_reduce,
+                                   bits=grad_reduce_bits)
+
+        def _body_local(state, tokens, targets):
+            with use_mesh(mesh), nn.logical_axis_rules(rules_local):
+                return _train_step_body(state, tokens, targets,
+                                        grad_reduce=_reduce)
+
+        state_manual_spec = jax.tree.map(lambda _: PartitionSpec(),
+                                         state_shardings)
+        batch_manual_spec = PartitionSpec(None, grad_reduce_axis)
+        wrapped = shard_map(
+            _body_local,
+            mesh=mesh,
+            in_specs=(state_manual_spec, batch_manual_spec,
+                      batch_manual_spec),
+            out_specs=(state_manual_spec, PartitionSpec()),
+            axis_names=frozenset({grad_reduce_axis}),
+            # the updated state IS invariant over the reduce axis (it is
+            # computed from the reduced grads), but all_gather-derived
+            # values type as varying — the static check can't see this
+            check_vma=False,
+        )
+        step_impl = wrapped
+    else:
+        step_impl = _train_step
+
     step_fn = jax.jit(
-        _train_step,
+        step_impl,
         in_shardings=(state_shardings, batch_shard, batch_shard),
         out_shardings=(state_shardings, None),
         donate_argnums=(0,) if donate_state else (),
@@ -227,6 +339,9 @@ def build_trainer(
         batch_sharding=batch_shard,
         accum_steps=accum_steps,
         micro_batch=micro_batch,
+        batch_abstract=jax.ShapeDtypeStruct(
+            (accum_steps, micro_batch, *sample_batch.shape[1:]),
+            jnp.int32, sharding=batch_shard),
     )
 
 

@@ -1,0 +1,129 @@
+"""Streaming per-layer trainer: parity with the dense step.
+
+The streaming step (trainer/streaming.py) is a hand-orchestrated
+backward: layer-local VJPs in a reverse fori_loop, optimizer update
+applied per layer in place. Its math must equal the dense
+``build_trainer`` step — every VJP uses pre-update params — so we
+assert loss + updated-params parity against it on a tiny model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models.llama import (
+    Llama,
+    LlamaConfig,
+    cross_entropy_loss,
+)
+from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
+from dlrover_tpu.trainer.streaming import (
+    StreamingState,
+    build_streaming_trainer,
+)
+from dlrover_tpu.trainer.train_step import build_trainer
+
+
+def _tiny_cfg(**kw):
+    return LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=3, num_heads=4, num_kv_heads=4, max_seq_len=16,
+        attn_impl="reference", norm_impl="reference",
+        embed_impl="gather", dtype=jnp.float32,
+        param_dtype=jnp.float32, **kw)
+
+
+def _tx():
+    return optax.chain(optax.scale_by_factored_rms(),
+                       optax.scale(-1e-2))
+
+
+def _dense_to_streaming(dense_state, cfg, tx) -> StreamingState:
+    """Repack the dense trainer's TrainState into StreamingState (layer_i
+    subtrees stacked on a leading axis), with fresh optimizer state (both
+    sides init deterministically per leaf)."""
+    # copy every reused leaf: both trainers donate their input state, so
+    # sharing buffers across the two steps would touch deleted arrays
+    params = jax.tree.map(jnp.copy, dense_state.params)
+    stacked = jax.tree.map(
+        lambda *leaves: jnp.stack(leaves),
+        *[params[f"layer_{i}"] for i in range(cfg.num_layers)])
+    head = None if cfg.tie_embeddings else params["lm_head"]
+    return StreamingState(
+        step=jnp.zeros((), jnp.int32),
+        block_params=stacked,
+        embed=params["embed"],
+        head=head,
+        norm_params={"weight": params["final_norm"]["weight"]},
+        block_opt=jax.vmap(tx.init)(stacked),
+        embed_opt=tx.init(params["embed"]),
+        head_opt=None if head is None else tx.init(head),
+        norm_opt=tx.init({"weight": params["final_norm"]["weight"]}),
+    )
+
+
+@pytest.mark.parametrize("tied", [False, True])
+def test_streaming_step_matches_dense(tied):
+    cfg = _tiny_cfg(tie_embeddings=tied)
+    micro, seq = 2, 16
+    tx = _tx()
+    mesh = create_mesh(MeshSpec(), jax.devices()[:1])
+    sample = jnp.zeros((micro, seq), jnp.int32)
+    dense = build_trainer(Llama(cfg), tx, mesh, sample,
+                          cross_entropy_loss, accum_steps=1,
+                          micro_batch=micro)
+    dense_state = dense.init(jax.random.PRNGKey(0))
+
+    streaming = build_streaming_trainer(cfg, tx, micro, seq)
+    s_state = _dense_to_streaming(dense_state, cfg, tx)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (micro, seq), np.int32)
+    targets = rng.integers(0, cfg.vocab_size, (micro, seq), np.int32)
+
+    tok_d, tgt_d = dense.shard_batch(tokens, targets)
+    new_dense, d_metrics = dense.step(dense_state, tok_d, tgt_d)
+
+    new_s, s_metrics = streaming.step(
+        s_state, jnp.asarray(tokens), jnp.asarray(targets))
+
+    np.testing.assert_allclose(float(s_metrics["loss"]),
+                               float(d_metrics["loss"]), rtol=1e-5)
+    # per-layer params must match the dense update
+    for i in range(cfg.num_layers):
+        got = jax.tree.map(lambda x: np.asarray(x)[i], new_s.block_params)
+        want = jax.tree.map(np.asarray, new_dense.params[f"layer_{i}"])
+        flat_got = jax.tree.leaves(got)
+        flat_want = jax.tree.leaves(want)
+        for g, w in zip(flat_got, flat_want):
+            np.testing.assert_allclose(g, w, rtol=2e-4, atol=2e-6)
+    np.testing.assert_allclose(
+        np.asarray(new_s.embed), np.asarray(new_dense.params["embed"]),
+        rtol=2e-4, atol=2e-6)
+    if not tied:
+        np.testing.assert_allclose(
+            np.asarray(new_s.head),
+            np.asarray(new_dense.params["lm_head"]),
+            rtol=2e-4, atol=2e-6)
+    np.testing.assert_allclose(
+        np.asarray(new_s.norm_params["weight"]),
+        np.asarray(new_dense.params["final_norm"]["weight"]),
+        rtol=2e-4, atol=2e-6)
+
+
+def test_streaming_loss_descends():
+    cfg = _tiny_cfg()
+    micro, seq = 2, 16
+    trainer = build_streaming_trainer(cfg, _tx(), micro, seq)
+    state = trainer.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (micro, seq), np.int32))
+    losses = []
+    for _ in range(8):
+        state, metrics = trainer.step(state, tokens, tokens)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 8
