@@ -175,6 +175,12 @@ def lower(context: ModelContext) -> AccelerateResult:
             num_micro = accum
         else:
             num_micro = max(plan.accum_steps, 2 * plan.pipeline_stages)
+        if plan.grad_reduce_bits:
+            logger.warning(
+                "quant_allreduce is not implemented for the pipeline "
+                "trainer: the data-axis gradient reduce stays exact "
+                "(grad_reduce_bits=%d ignored under "
+                "pipeline_parallel)", plan.grad_reduce_bits)
         trainer = build_pipeline_trainer(
             cfg, context.make_optimizer(), mesh,
             num_microbatches=num_micro, micro_batch=micro,
@@ -183,6 +189,7 @@ def lower(context: ModelContext) -> AccelerateResult:
             num_rounds=plan.pipeline_rounds,
             rules=rules,
             offload_opt_state=plan.offload_optimizer,
+            bound_activations=plan.pipeline_bound_activations,
         )
         return AccelerateResult(trainer=trainer, mesh=mesh,
                                 model=context.model, strategy=[],
@@ -199,6 +206,7 @@ def lower(context: ModelContext) -> AccelerateResult:
         rules=rules,
         donate_state=plan.donate_state,
         offload_opt_state=plan.offload_optimizer,
+        grad_reduce_bits=plan.grad_reduce_bits,
     )
     return AccelerateResult(trainer=trainer, mesh=mesh,
                             model=context.model, strategy=[],

@@ -123,11 +123,22 @@ def analyse(context: ModelContext, micro_batch: int = 1) -> Dict[str, Any]:
         "seq_len": seq_len,
         "device_hbm_bytes": hbm_bytes,
         "n_devices": len(context.devices),
+        # DCN granules (mirrors parallel/mesh.py's hybrid-mesh rule):
+        # slices when reported, else processes — >1 means the data-axis
+        # gradient reduce crosses the slow fabric
+        "n_dcn_granules": _dcn_granules(context.devices),
         "fits_one_device": (
             hbm_bytes == 0
             or train_state_bytes < hbm_bytes * STATE_HBM_FRACTION),
         **dims,
     }
+
+
+def _dcn_granules(devices) -> int:
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None in slice_ids:
+        return len({getattr(d, "process_index", 0) for d in devices})
+    return len(slice_ids)
 
 
 def _divisors_of(n: int):

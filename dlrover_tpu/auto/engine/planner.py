@@ -121,6 +121,12 @@ def plan_candidates(context: ModelContext,
         optional.append(name)
 
     extras: List[Strategy] = []
+    if info.get("n_dcn_granules", 1) > 1:
+        # multi-slice: the data-axis gradient reduce crosses DCN — plan
+        # the int8 compressed reduce as an alternative the dry-run can
+        # score against the exact reduce (reference: quant_reduce.cu)
+        extras.append(list(forced) + [("half", {}),
+                                      ("quant_allreduce", {"bits": 8})])
     pipe = _pipeline_size(info, n_devices)
     if pipe > 1 and n_devices % (pipe * sizing["expert"]) == 0:
         extra: Strategy = [("half", {}), ("module_replace", {})]

@@ -45,6 +45,11 @@ class AccelerationPlan:
     donate_state: bool = True
     # optimizer moments in host memory (reference: adam_offload)
     offload_optimizer: bool = False
+    # 8/4 = int-quantized gradient all-reduce over the data/DCN axis
+    # (reference: quant_reduce.cu); 0 = exact
+    grad_reduce_bits: int = 0
+    # 1F1B-style live-activation bound for PP (checkpointed windows)
+    pipeline_bound_activations: bool = False
     extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 

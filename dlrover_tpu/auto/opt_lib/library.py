@@ -119,6 +119,26 @@ class OffloadOptimizerOptimization(Optimization):
         context.plan.offload_optimizer = True
 
 
+class QuantizedAllreduceOptimization(Optimization):
+    """int8/int4 groupwise gradient all-reduce over the data/DCN axis
+    (reference: the quant_reduce CUDA kernel,
+    atorch/ops/csrc/quantization/quant_reduce.cu:248 — dequantize N
+    partitions, reduce, requantize for the wire). On multi-slice meshes
+    the data-axis gradient reduce rides DCN (`_dcn_split`,
+    parallel/mesh.py) and is the bandwidth bottleneck this compresses.
+    config: {"bits": 8|4}."""
+
+    name = "quant_allreduce"
+    distributed = True
+
+    def apply(self, context, config):
+        bits = int(config.get("bits", 8))
+        if bits not in (8, 4):
+            raise ValueError(
+                f"quant_allreduce bits must be 8 or 4, got {bits}")
+        context.plan.grad_reduce_bits = bits
+
+
 class TensorParallelOptimization(Optimization):
     """Megatron-style TP: column/row splits come from the logical-axis rule
     table, no module surgery. config: {"size": N}."""
@@ -173,6 +193,9 @@ class PipelineParallelOptimization(Optimization):
         context.plan.pipeline_stages = size
         # rounds > 1 = circular/interleaved schedule (bubble ÷ rounds)
         context.plan.pipeline_rounds = int(config.get("rounds", 1))
+        # 1F1B-style live-activation bound (checkpointed step windows)
+        context.plan.pipeline_bound_activations = bool(
+            config.get("memory_bound", False))
         _set_mesh_dim(context, MeshAxis.PIPE, size)
 
 
@@ -236,6 +259,7 @@ class OptimizationLibrary:
             MixedParallelOptimization,
             ThreeDParallelOptimization,
             OffloadOptimizerOptimization,
+            QuantizedAllreduceOptimization,
         ):
             opt = opt_cls()
             self.opts[opt.name] = opt

@@ -56,14 +56,22 @@ def probe_main() -> int:
     import jax
     import jax.numpy as jnp
 
-    t0 = time.perf_counter()
-    # MXU sanity: a bf16 matmul burst on every local chip.
-    x = jnp.ones((_MATMUL_SIZE, _MATMUL_SIZE), jnp.bfloat16)
-    for _ in range(3):
-        x = jnp.tanh(x @ x * 1e-4)
-    jax.block_until_ready(x)
-    # ICI/DCN sanity: repeated all-gather across every chip in the group.
+    # The matmul burst is MXU sanity — on the CPU backend (tests, dev
+    # boxes) a 4096^3 bf16 burst is ~400 GFLOPs of pure execution that
+    # starves a loaded host and flakes the pair's coordination-service
+    # deadlines; small there, full-size on real chips.
+    size = _MATMUL_SIZE if jax.default_backend() == "tpu" else 512
+    x = jnp.ones((size, size), jnp.bfloat16)
+
+    @jax.jit
+    def matmul_burst(x):
+        for _ in range(3):
+            x = jnp.tanh(x @ x * 1e-4)
+        return x
+
     n = jax.device_count()
+    gather_sum = None
+    data = None
     if n > 1:
         from jax.sharding import Mesh, PartitionSpec as P
         from jax import shard_map
@@ -81,8 +89,20 @@ def probe_main() -> int:
                 inner, mesh=mesh, in_specs=P("probe"), out_specs=P("probe")
             )(arr)
 
+    # compile OUTSIDE the timed window: the elapsed that feeds straggler
+    # detection (2x median) must compare EXECUTION, and a peer stuck in
+    # a cold compile mid-collective is what tripped the coordination
+    # service's deadline under load (round-3 flake)
+    matmul_exec = matmul_burst.lower(x).compile()
+    gather_exec = (gather_sum.lower(data).compile()
+                   if gather_sum is not None else None)
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(matmul_exec(x))
+    if gather_exec is not None:
+        # ICI/DCN sanity: repeated all-gather across the group's chips
         for _ in range(_REPEATS):
-            out = gather_sum(data)
+            out = gather_exec(data)
         jax.block_until_ready(out)
         expected = float(n * _ALLGATHER_FLOATS)
         if abs(float(out[0]) - expected) > 1e-3 * expected:

@@ -194,6 +194,7 @@ def pipeline_train(
     axis: str = MeshAxis.PIPE,
     remat: bool = False,
     chunk_has_aux: bool = False,
+    activation_groups: int = 0,
 ) -> jax.Array:
     """Circular (interleaved) pipeline producing the mean microbatch loss.
 
@@ -253,12 +254,47 @@ def pipeline_train(
     num_groups = -(-num_micro // num_stages)     # ceil
     steps = num_groups * num_stages * num_rounds + num_stages - 1
     fn = jax.checkpoint(chunk_fn) if remat else chunk_fn
-
+    # act shape from the REAL dtypes (before any fp32 boundary cast)
     act_shape = jax.eval_shape(enter_fn, shared_params, tokens[0])
+
+    # XLA-CPU workaround: shard_map's transpose psums the SHARED params'
+    # gradients over pipe (they enter replicated), and the CPU backend
+    # CHECK-fails promoting that half-precision all-reduce ("Invalid
+    # binary instruction opcode copy"). Route shared params through an
+    # fp32 boundary — the transpose psum then runs fp32 — and cast back
+    # to the compute dtype inside, so ALL compute (and the activation
+    # ppermute, which the CPU backend handles fine in bf16) keeps the
+    # real dtypes. TPU/GPU take the direct path.
+    _half = (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16))
+    cast_boundary = (jax.default_backend() == "cpu" and any(
+        jnp.dtype(leaf.dtype) in _half
+        for leaf in jax.tree.leaves(shared_params)
+        if hasattr(leaf, "dtype")))
+    if cast_boundary:
+        shared_dtypes = jax.tree.map(lambda l: l.dtype, shared_params)
+        shared_params = jax.tree.map(
+            lambda l: l.astype(jnp.float32)
+            if jnp.dtype(l.dtype) in _half else l, shared_params)
+
+        def _restore_shared(shared):
+            # order matters: mark the fp32 leaves VARYING first, THEN
+            # cast to the compute dtype. The grad psum is inserted at
+            # the pvary transpose — done this way it reduces the fp32
+            # cotangent; cast-first would put the bf16 all-reduce right
+            # back (psum_invariant on the bf16 value, the instruction
+            # the CPU compiler CHECK-fails on)
+            shared = jax.tree.map(lambda l: _varying(l, axis), shared)
+            return jax.tree.map(lambda l, d: l.astype(d), shared,
+                                shared_dtypes)
+    else:
+        def _restore_shared(shared):
+            return shared
+
     micro = tokens.shape[1]
     fwd_perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
     def body(chunk_params, shared, tokens, targets):
+        shared = _restore_shared(shared)
         # chunk leaves arrive (C, 1, layers_per_chunk, ...): drop the
         # sharded stage dim
         local_chunks = jax.tree.map(lambda p: p[:, 0], chunk_params)
@@ -310,8 +346,32 @@ def pipeline_train(
         act0 = _varying(jnp.zeros(act_shape.shape, act_shape.dtype), axis)
         loss0 = _varying(jnp.zeros((micro,), jnp.float32), axis)
         aux0 = _varying(jnp.zeros((), jnp.float32), axis)
-        (_, loss_rows, aux_acc), _ = lax.scan(step, (act0, loss0, aux0),
-                                              jnp.arange(steps))
+        carry0 = (act0, loss0, aux0)
+        if activation_groups and steps > activation_groups:
+            # 1F1B-style memory profile WITHOUT changing the schedule
+            # (reference analog: PiPPy's 1F1B bounds live microbatch
+            # activations to ~num_stages,
+            # distributed_pippy_compiler.py:378). The step scan's
+            # linearization residuals grow O(steps) ~ O(M); grouping
+            # the scan into checkpointed windows of `activation_groups`
+            # (= num_stages) steps stores only the carry at group
+            # boundaries and recomputes one group at a time in the
+            # backward — live residuals bound to one group (~S
+            # microbatches in flight), bubble unchanged, at the
+            # standard one-extra-forward remat cost.
+            pad_steps = (-steps) % activation_groups
+            ts = jnp.arange(steps + pad_steps)  # padded tail: valid=False
+            groups = ts.reshape(-1, activation_groups)
+
+            @jax.checkpoint
+            def group_body(carry, ts_g):
+                return lax.scan(step, carry, ts_g)
+
+            (_, loss_rows, aux_acc), _ = lax.scan(group_body, carry0,
+                                                  groups)
+        else:
+            (_, loss_rows, aux_acc), _ = lax.scan(step, carry0,
+                                                  jnp.arange(steps))
         # only the last stage accumulated anything; reductions (pipe
         # psum here, row mean outside) stay OUT of the cond branches
         return lax.psum(loss_rows, axis), lax.psum(aux_acc, axis)

@@ -388,7 +388,8 @@ class PipelinedTrainer:
                  mesh: Mesh, num_microbatches: int, micro_batch: int,
                  seq_len: int, num_rounds: int = 1, remat: bool = False,
                  rules: Optional[Sequence] = None,
-                 offload_opt_state: bool = False):
+                 offload_opt_state: bool = False,
+                 bound_activations: bool = False):
         self.spec = spec
         self._offload = offload_opt_state
         self.mesh = mesh
@@ -400,6 +401,7 @@ class PipelinedTrainer:
         self.seq_len = seq_len
         self._tx = tx
         self._remat = remat
+        self._bound_activations = bound_activations
         self._rules = list(rules if rules is not None else DEFAULT_RULES)
         # batch arrays: (M, micro, seq) with micro rows over the dp axes
         self.batch_sharding = NamedSharding(mesh, P(None, _BATCH_AXES))
@@ -543,7 +545,11 @@ class PipelinedTrainer:
             self.mesh, spec.chunk_fn, params["chunks"], params["shared"],
             spec.enter_fn, spec.exit_fn, tokens, targets,
             num_rounds=self.num_rounds, remat=self._remat,
-            chunk_has_aux=spec.has_aux)
+            chunk_has_aux=spec.has_aux,
+            # 1F1B-style bound: one checkpointed window of num_stages
+            # schedule steps live at a time (see pipeline_train)
+            activation_groups=(self.num_stages
+                               if self._bound_activations else 0))
 
     def step(self, state: TrainState, tokens, targets):
         if self._step is None:
@@ -569,7 +575,8 @@ def build_pipeline_trainer(cfg: Union[LlamaConfig, GPTConfig],
                            num_rounds: int = 1,
                            remat: bool = False,
                            rules: Optional[Sequence] = None,
-                           offload_opt_state: bool = False
+                           offload_opt_state: bool = False,
+                           bound_activations: bool = False
                            ) -> PipelinedTrainer:
     """Lower a stacked-block model config to a pipelined trainer.
 
@@ -581,22 +588,31 @@ def build_pipeline_trainer(cfg: Union[LlamaConfig, GPTConfig],
     mean over its batch rows (cross_entropy_loss qualifies). The pipeline
     applies it per microbatch row and averages — a sum-reducing loss
     would silently change scale vs the dense trainer."""
+    # bf16 pipelines compile everywhere: the XLA-CPU half-precision
+    # collective bug is dodged surgically inside pipeline_train (shared
+    # params cross the shard_map boundary in fp32 on CPU — pvary'd
+    # BEFORE the compute-dtype cast — so their grad psum, the
+    # instruction the CPU compiler CHECK-failed on, runs fp32 while
+    # every stage computes in the real dtype). One residue: MoE chunks
+    # under PP put the expert axis auto INSIDE the pipe-manual region,
+    # and GSPMD inserts bf16 expert collectives there that the same CPU
+    # promotion pass chokes on — those configs force fp32 on CPU only.
+    from dlrover_tpu.models.llama_moe import LlamaMoEConfig
+
     if (jax.default_backend() == "cpu"
+            and isinstance(cfg, LlamaMoEConfig)
+            and getattr(cfg, "num_experts", 0) > 0
             and jnp.dtype(cfg.dtype) in (jnp.bfloat16, jnp.float16)):
-        # XLA's CPU backend CHECK-fails (AllReducePromotion: "Invalid
-        # binary instruction opcode copy") compiling the pipeline's
-        # half-precision collectives; fp32 keeps CPU dry-runs/tests
-        # alive. Only the CPU backend — TPU/GPU handle bf16 collectives.
         from dlrover_tpu.common.log import default_logger as logger
 
-        logger.info("pipeline trainer: forcing fp32 compute on the %s "
-                    "backend (half-precision pipeline collectives hit an "
-                    "XLA CPU compiler bug)", jax.default_backend())
+        logger.info("MoE pipeline: forcing fp32 on the cpu backend "
+                    "(GSPMD-inserted half-precision expert collectives "
+                    "inside the pipe-manual region hit the XLA-CPU "
+                    "promotion bug); dense pipelines stay bf16")
         replace = {"dtype": jnp.float32}
         if jnp.dtype(cfg.param_dtype) in (jnp.bfloat16, jnp.float16):
             replace["param_dtype"] = jnp.float32
         cfg = dataclasses.replace(cfg, **replace)
-    from dlrover_tpu.models.llama_moe import LlamaMoEConfig
 
     if isinstance(cfg, LlamaMoEConfig):
         # (checked before LlamaConfig — LlamaMoEConfig subclasses it;
@@ -619,4 +635,5 @@ def build_pipeline_trainer(cfg: Union[LlamaConfig, GPTConfig],
     return PipelinedTrainer(spec, tx, mesh, num_microbatches,
                             micro_batch, seq_len, num_rounds=num_rounds,
                             remat=remat, rules=rules,
-                            offload_opt_state=offload_opt_state)
+                            offload_opt_state=offload_opt_state,
+                            bound_activations=bound_activations)

@@ -195,16 +195,36 @@ def build_streaming_trainer(
 
         # ---- head + final norm: ordinary VJP (small params) ----------
         head_param = state.embed if state.head is None else state.head
+        # chunk the (seq, vocab) logits over sequence with per-chunk
+        # recompute: peak logits memory = one chunk, not B*S*V fp32
+        # (for 7B at seq 2048 that's ~790 MB of softmax temps saved)
 
         def head_loss(norm_params, head_p, h):
             x = norm.apply({"params": norm_params}, h)
             w = head_p.astype(cfg.dtype)
-            logits = jnp.dot(x, w.T if state.head is None else w)
-            logits = logits.astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(
-                logp, targets[..., None], axis=-1)[..., 0]
-            return jnp.mean(nll)
+            wt = w.T if state.head is None else w
+            b, s, hid = x.shape
+            # chunk from the RUNTIME length (trace-time static), so any
+            # sequence length steps — not just the build-time one
+            seq_chunk = next((c for c in (512, 256, 128)
+                              if s % c == 0), s)
+            nc = s // seq_chunk
+            xc = x.reshape(b, nc, seq_chunk, hid).swapaxes(0, 1)
+            tc = targets.reshape(b, nc, seq_chunk).swapaxes(0, 1)
+
+            @jax.checkpoint
+            def chunk_nll(x_chunk, t_chunk):
+                logits = jnp.dot(x_chunk, wt).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                return jnp.sum(-jnp.take_along_axis(
+                    logp, t_chunk[..., None], axis=-1)[..., 0])
+
+            def body(acc, ct):
+                return acc + chunk_nll(*ct), None
+
+            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                    (xc, tc))
+            return total / (b * s)
 
         loss, head_vjp = jax.vjp(
             head_loss, state.norm_params, head_param, h)

@@ -111,6 +111,73 @@ def _run_training(grad_reduce_bits, steps=6):
     return losses
 
 
+class _GranuleDevice:
+    """Real CPU device with a faked DCN granule (process) identity."""
+
+    def __init__(self, device, process_index):
+        self._device = device
+        self.process_index = process_index
+
+    def __getattr__(self, name):
+        return getattr(self._device, name)
+
+
+def test_planner_emits_quant_allreduce_on_multi_slice():
+    import optax
+
+    from dlrover_tpu.auto.engine.planner import plan_candidates
+    from dlrover_tpu.auto.model_context import ModelContext
+
+    cfg = _tiny_cfg()
+    devices = [_GranuleDevice(d, i // 4)
+               for i, d in enumerate(jax.devices()[:8])]
+    context = ModelContext(
+        Llama(cfg),
+        optim_factory=lambda lr=1e-3: optax.adamw(lr),
+        loss_fn=cross_entropy_loss,
+        sample_batch=np.zeros((2, 16), np.int32),
+        devices=devices,
+    )
+    candidates = plan_candidates(context, max_candidates=16)
+    assert any("quant_allreduce" in [n for n, _ in s]
+               for s in candidates), candidates
+    # single-granule: not planned
+    context_one = ModelContext(
+        Llama(cfg),
+        optim_factory=lambda lr=1e-3: optax.adamw(lr),
+        loss_fn=cross_entropy_loss,
+        sample_batch=np.zeros((2, 16), np.int32),
+        devices=jax.devices()[:8],
+    )
+    assert not any(
+        "quant_allreduce" in [n for n, _ in s]
+        for s in plan_candidates(context_one, max_candidates=16))
+
+
+def test_auto_accelerate_explicit_quant_allreduce():
+    from dlrover_tpu.auto.accelerate import auto_accelerate
+
+    cfg = _tiny_cfg()
+    result = auto_accelerate(
+        Llama(cfg),
+        loss_fn=cross_entropy_loss,
+        sample_batch=np.zeros((8, 16), np.int32),
+        strategy=[("parallel_mode", {"data": 8}),
+                  ("quant_allreduce", {"bits": 8})],
+        devices=jax.devices()[:8],
+    )
+    trainer = result.trainer
+    rng = np.random.default_rng(5)
+    tokens = rng.integers(0, cfg.vocab_size, (8, 16), np.int32)
+    tok, tgt = trainer.shard_batch(tokens, tokens)
+    state = trainer.init(jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(4):
+        state, metrics = trainer.step(state, tok, tgt)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
 def test_trainer_with_quantized_reduce_tracks_exact():
     """Training-impact check: int8 gradient reduce must track the exact
     reduce's loss curve (same seed, same data) closely."""
