@@ -132,6 +132,46 @@ def run_restore_bench(timeout_s: float = 480.0,
         return -1.0
 
 
+def _timed_loop(step_fn, state, tok, tgt, warmup=2, steps=5):
+    """Shared warmup + timed-window protocol. The float() host fetches
+    force the full chain to execute — necessary under remote-execution
+    backends (block_until_ready does not wait on the axon tunnel).
+    Returns (state, seconds, warmup_loss, final_loss)."""
+    for _ in range(warmup):
+        state, metrics = step_fn(state, tok, tgt)
+    warmup_loss = float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, tok, tgt)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return state, dt, warmup_loss, final_loss
+
+
+def _model_flops_per_token(cfg, seq: int) -> float:
+    """6·params credits fwd+bwd matmul FLOPs. With the gather lookup the
+    input embedding table does no matmul, so untied embed params are not
+    credited (tied ones are: the same matrix IS the head matmul). The
+    attention term is QK^T + PV = 4·h·s FLOPs/token fwd, ×3 for
+    fwd+bwd, ÷2 causal (the kernel skips above-diagonal blocks)."""
+    counted = cfg.param_count()
+    if cfg.embed_impl == "gather" and not cfg.tie_embeddings:
+        counted -= cfg.vocab_size * cfg.hidden_size
+    return 6.0 * counted + 6.0 * cfg.num_layers * cfg.hidden_size * seq
+
+
+def _oom_report(e: Exception, **extra) -> int:
+    """OOM and friends: the reason IS the result, not a failure."""
+    reason = str(e)
+    key = reason.find("memory space")
+    if key >= 0:
+        reason = reason[max(0, key - 160):key + 160]
+    out = {"error": reason[:400]}
+    out.update(extra)
+    print(json.dumps(out))
+    return 0
+
+
 def _seven_b_streaming() -> int:
     """Llama-7B on a <20 GB chip via the streaming per-layer trainer
     (trainer/streaming.py): backward is a reverse per-layer loop that
@@ -144,10 +184,12 @@ def _seven_b_streaming() -> int:
     from dlrover_tpu.trainer.streaming import build_streaming_trainer
 
     micro, seq = 1, 2048
+    # untied embeddings — real Llama-7B has a separate lm_head; tying
+    # would shave vocab·hidden params (~2%) and overstate the number
     cfg = LlamaConfig.llama_7b(
         max_seq_len=seq, attn_impl="flash", embed_impl="gather",
         norm_impl="fused", dtype=jnp.bfloat16,
-        param_dtype=jnp.bfloat16, tie_embeddings=True)
+        param_dtype=jnp.bfloat16)
     tx = optax.chain(optax.scale_by_factored_rms(),
                      optax.scale(-3e-4))
     trainer = build_streaming_trainer(cfg, tx, micro, seq)
@@ -172,32 +214,18 @@ def _seven_b_streaming() -> int:
         # reuse the AOT executable: trainer.step would re-trace and pay
         # the (on-chip, minutes-long) compile a second time
         trainer.step_fn = lambda s, t, tg: compiled(s, t, tg)
-        for _ in range(2):
-            state, metrics = trainer.step(state, tokens, tokens)
-        float(metrics["loss"])
         steps = 5
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = trainer.step(state, tokens, tokens)
-        float(metrics["loss"])
-        dt = time.perf_counter() - t0
+        _, dt, _, _ = _timed_loop(trainer.step, state, tokens, tokens,
+                                  warmup=2, steps=steps)
         tokens_per_sec = micro * seq * steps / dt
-        flops_per_token = 6.0 * cfg.param_count() + (
-            6.0 * cfg.num_layers * cfg.hidden_size * seq)
-        mfu = (tokens_per_sec * flops_per_token
+        mfu = (tokens_per_sec * _model_flops_per_token(cfg, seq)
                / peak_flops(jax.devices()[0]))
         print(json.dumps({"tokens_per_sec": round(tokens_per_sec, 1),
                           "mfu": round(mfu, 4), "mode": "streaming",
                           "memory": mem}))
         return 0
     except Exception as e:
-        reason = str(e)
-        key = reason.find("memory space")
-        if key >= 0:
-            reason = reason[max(0, key - 160):key + 160]
-        print(json.dumps({"error": reason[:400], "mode": "streaming",
-                          "memory": mem}))
-        return 0
+        return _oom_report(e, mode="streaming", memory=mem)
 
 
 def seven_b_main() -> int:
@@ -236,31 +264,17 @@ def seven_b_main() -> int:
         tokens = rng.integers(0, cfg.vocab_size, (micro, seq),
                               dtype=np.int32)
         tok, tgt = trainer.shard_batch(tokens, tokens)
-        for _ in range(2):
-            state, metrics = trainer.step(state, tok, tgt)
-        float(metrics["loss"])          # force execution (axon tunnel)
         steps = 5
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = trainer.step(state, tok, tgt)
-        float(metrics["loss"])
-        dt = time.perf_counter() - t0
+        _, dt, _, _ = _timed_loop(trainer.step, state, tok, tgt,
+                                  warmup=2, steps=steps)
         tokens_per_sec = micro * seq * steps / dt
-        flops_per_token = 6.0 * (cfg.param_count()
-                                 - cfg.vocab_size * cfg.hidden_size) + (
-            6.0 * cfg.num_layers * cfg.hidden_size * seq)
-        mfu = (tokens_per_sec * flops_per_token
+        mfu = (tokens_per_sec * _model_flops_per_token(cfg, seq)
                / peak_flops(jax.devices()[0]))
         print(json.dumps({"tokens_per_sec": round(tokens_per_sec, 1),
                           "mfu": round(mfu, 4)}))
         return 0
-    except Exception as e:  # OOM and friends: the reason IS the result
-        reason = str(e)
-        key = reason.find("memory space")
-        if key >= 0:
-            reason = reason[max(0, key - 160):key + 160]
-        print(json.dumps({"error": reason[:400]}))
-        return 0
+    except Exception as e:
+        return _oom_report(e)
 
 
 def run_7b_bench(timeout_s: float = 900.0) -> dict:
@@ -336,17 +350,8 @@ def _measure() -> dict:
     targets = rng.integers(0, cfg.vocab_size, (micro, seq), dtype=np.int32)
     tok, tgt = trainer.shard_batch(tokens, targets)
 
-    for _ in range(warmup):
-        state, metrics = trainer.step(state, tok, tgt)
-    # A host fetch (not just block_until_ready) forces the full chain to
-    # execute — necessary under remote-execution backends.
-    warmup_loss = float(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = trainer.step(state, tok, tgt)
-    final_loss = float(metrics["loss"])
-    dt = time.perf_counter() - t0
+    _, dt, warmup_loss, final_loss = _timed_loop(
+        trainer.step, state, tok, tgt, warmup=warmup, steps=steps)
     assert final_loss == final_loss, "NaN loss"
     if final_loss >= warmup_loss:
         # a ~10-step window on synthetic data is noisy; a non-descending
@@ -354,21 +359,9 @@ def _measure() -> dict:
         print(f"WARNING: loss did not descend over the timed window "
               f"({warmup_loss} -> {final_loss})", file=sys.stderr)
 
-    tokens_per_step = micro * seq
-    tokens_per_sec = tokens_per_step * steps / dt
-    # 6·params credits fwd+bwd matmul FLOPs; with the gather lookup the
-    # input embedding table does no matmul at all, so its params must not
-    # be credited (otherwise MFU is inflated ~9% on the 0.4B config).
-    counted_params = cfg.param_count()
-    if cfg.embed_impl == "gather" and not cfg.tie_embeddings:
-        counted_params -= cfg.vocab_size * cfg.hidden_size
-    flops_per_token = 6.0 * counted_params + (
-        # causal attention term: QK^T + PV are 4·h·s FLOPs/token fwd,
-        # ×3 for fwd+bwd, ÷2 causal (the kernel skips above-diagonal
-        # blocks) — 6·L·h·s with h=hidden, s=seq
-        6.0 * cfg.num_layers * cfg.hidden_size * seq
-    )
-    mfu = tokens_per_sec * flops_per_token / peak_flops(jax.devices()[0])
+    tokens_per_sec = micro * seq * steps / dt
+    mfu = (tokens_per_sec * _model_flops_per_token(cfg, seq)
+           / peak_flops(jax.devices()[0]))
     return {
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu": round(mfu, 4),

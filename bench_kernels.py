@@ -25,15 +25,40 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def bench_one(fn, args, steps=20, warmup=3):
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
+def _force(out) -> float:
+    """Force execution with a host transfer of one scalar. On the axon
+    tunnel `block_until_ready` does not actually wait; pulling a scalar
+    does, and device execution is in-order, so forcing the last step's
+    output proves all prior steps finished."""
+    leaf = jax.tree.leaves(out)[0]
+    return float(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def bench_one(fn, args, reps=20, timed_calls=3):
+    """Time `fn` amortized over `reps` sequential calls INSIDE one jitted
+    program (a scan whose carry perturbs q each iteration, so calls can't
+    be CSE'd) — per-call dispatch through the axon tunnel costs ~3 ms,
+    which swamps a ~1 ms kernel when timed call-by-call; inside the
+    model's jitted step the kernel pays no such cost."""
+    q0, *rest = args
+
+    @jax.jit
+    def many(q, *rest):
+        def body(c, _):
+            o = fn(c, *rest)
+            lead = jax.tree.leaves(o)[0]
+            return c + 1e-6 * lead.astype(c.dtype), None
+
+        c, _ = jax.lax.scan(body, q, None, length=reps)
+        return c
+
+    out = many(q0, *rest)          # compile + warm
+    _force(out)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps
+    for _ in range(timed_calls):
+        out = many(q0, *rest)
+    _force(out)
+    return (time.perf_counter() - t0) / (timed_calls * reps)
 
 
 def main() -> None:

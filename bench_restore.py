@@ -202,16 +202,17 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
         # 1.47B state is 5.5 GB of fp32 masters, and at-scale restore
         # time is dominated by moving those bytes (measured 262 s raw);
         # the codec cuts them ~3.9x with no measurable resume-loss
-        # impact. BENCH_RESTORE_QUANT_BITS=8 enables it; the default
-        # stays exact dtypes until the per-leaf encode is validated on
-        # the real chip (the first whole-tree encoder wedged the
-        # tunnel mid-save; see docs/benchmarks.md).
+        # impact, validated on the real chip round 5 (per-leaf encode,
+        # 1.34 GB vs 5.08 GB, Orbax read 21.7 s vs ~95 s — see
+        # docs/benchmarks.md "Round-5 on-chip evidence"), so int8 is
+        # now the default; BENCH_RESTORE_QUANT_BITS=0 reverts to the
+        # exact-dtype baseline.
         # pinned unconditionally (incl. "0"): the worker env overlays
         # the ambient environment, and an exported
         # DLROVER_TPU_CKPT_QUANT_BITS must not silently quantize the
         # run that reports itself as the exact-dtype baseline
         worker_env["DLROVER_TPU_CKPT_QUANT_BITS"] = os.environ.get(
-            "BENCH_RESTORE_QUANT_BITS", "0")
+            "BENCH_RESTORE_QUANT_BITS", "8")
     spec = WorkerSpec(
         entrypoint=entrypoint,
         devices_per_node=1,

@@ -135,10 +135,9 @@ def analyse(context: ModelContext, micro_batch: int = 1) -> Dict[str, Any]:
 
 
 def _dcn_granules(devices) -> int:
-    slice_ids = {getattr(d, "slice_index", None) for d in devices}
-    if None in slice_ids:
-        return len({getattr(d, "process_index", 0) for d in devices})
-    return len(slice_ids)
+    from dlrover_tpu.parallel.mesh import dcn_granules
+
+    return dcn_granules(devices)[0]
 
 
 def _divisors_of(n: int):

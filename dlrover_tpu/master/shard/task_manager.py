@@ -124,7 +124,13 @@ class TaskManager:
             return dataset.checkpoint() if dataset else None
 
     def restore_dataset_checkpoint(self, content: str) -> bool:
-        ckpt = DatasetShardCheckpoint.from_json(content)
+        try:
+            ckpt = DatasetShardCheckpoint.from_json(content)
+        except (ValueError, KeyError, TypeError):
+            # a worker restoring a checkpoint written before any dataset
+            # was registered (or a corrupted payload) must not traceback
+            # in the master's log — the report RPC just answers False
+            return False
         with self._lock:
             dataset = self._datasets.get(ckpt.dataset_name)
             if dataset is None:

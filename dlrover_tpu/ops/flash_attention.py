@@ -35,11 +35,25 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# exp2-domain softmax: fold log2(e) into the score scale so every
+# transcendental in the kernels is a bare exp2 (TPU lowers exp via exp2
+# anyway; doing it explicitly saves the per-element argument multiply).
+# The SAVED logsumexp stays in natural-log units — ring attention
+# (parallel/ring_attention.py) merges lse across ring steps with
+# natural exp/log.
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+
 # v5e-tuned default block sizes (92 TF/s fwd vs 11 at 128×128); capped by
 # the actual sequence length via fit_block. Shared with the ring-flash
 # path (parallel/ring_attention.py).
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+
+# Grid axes (batch, heads, outer-block) are independent; the innermost
+# axis carries the VMEM accumulators and must stay sequential.
+_DIM_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
 def _use_interpret() -> bool:
@@ -62,6 +76,22 @@ def _sds(shape, dtype, vma):
 
 def _cdiv(a: int, b: int) -> int:
     return (a + b - 1) // b
+
+
+def _causal_dispatch(compute, q_start, k_start,
+                     block_q: int, block_k: int) -> None:
+    """Run `compute(masked)` for a causal (q, k) block pair: skip blocks
+    entirely above the diagonal, and pay the iota/select mask VPU work
+    only on blocks that straddle it. Static per-block skip is impossible
+    (q_start/k_start are dynamic over the grid), so dispatch with
+    pl.when. Shared by the forward and both backward kernels so the
+    boundary conditions cannot drift apart."""
+    needed = k_start <= q_start + block_q - 1
+    full = k_start + block_k - 1 <= q_start
+    pl.when(jnp.logical_and(needed, full))(
+        lambda: compute(False))
+    pl.when(jnp.logical_and(needed, jnp.logical_not(full)))(
+        lambda: compute(True))
 
 
 def fit_block(n: int, block: int) -> int:
@@ -105,19 +135,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     q_start = qi * block_q
     k_start = ki * block_k
 
-    # Causal: the whole block is masked out iff its first k position is
-    # beyond the last q position.
-    block_needed = (not causal) or (k_start <= q_start + block_q - 1)
-
-    def _compute():
+    def _compute(masked: bool):
         # Inputs stay in their native dtype (bf16) so the MXU runs at full
         # rate; accumulation is fp32 via preferred_element_type (the
         # FlashAttention-2 numerics). fp32 operands pass through unchanged.
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * (
+            sm_scale * LOG2E)
+        if masked:
             q_idx = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_idx = k_start + jax.lax.broadcasted_iota(
@@ -126,26 +153,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_prev = m_ref[:]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
     if causal:
-        # Static per-block skip is impossible (q_start/k_start are dynamic
-        # over the grid), so use pl.when.
-        pl.when(k_start <= q_start + block_q - 1)(_compute)
+        # Only blocks straddling the diagonal pay the iota/select VPU
+        # work (at seq 2048 that's 2 of 3 computed blocks; at 8k only
+        # 8 of 36).
+        _causal_dispatch(_compute, q_start, k_start, block_q, block_k)
     else:
-        _compute()
+        _compute(False)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
         l = l_ref[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)
+        lse_ref[0, 0] = (m_ref[:] + jnp.log2(l_safe)) * LN2
 
 
 def _flash_fwd(q, k, v, sm_scale: float, causal: bool,
@@ -164,6 +192,11 @@ def _flash_fwd(q, k, v, sm_scale: float, causal: bool,
         return (b, h, qi, 0)
 
     def kv_map(b, h, qi, ki):
+        if causal:
+            # Blocks above the diagonal are skipped by the kernel; map
+            # their kv index to the last needed block so consecutive
+            # grid steps see the same index and Pallas elides the DMA.
+            ki = jnp.minimum(ki, ((qi + 1) * block_q - 1) // block_k)
         return (b, h // group, ki, 0)
 
     def o_map(b, h, qi, ki):
@@ -195,6 +228,7 @@ def _flash_fwd(q, k, v, sm_scale: float, causal: bool,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
+        compiler_params=_DIM_SEMANTICS,
         interpret=_use_interpret(),
     )(q, k, v)
     return out, lse
@@ -219,29 +253,30 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_start = qi * block_q
     k_start = ki * block_k
 
-    def _compute():
+    def _compute(masked: bool):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0]
+        lse = lse_ref[0, 0] * LOG2E    # nat -> exp2 domain (per row)
         delta = delta_ref[0, 0]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * (
+            sm_scale * LOG2E)
+        if masked:
             q_idx = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_idx = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_idx >= k_idx, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         dq_acc_ref[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(k_start <= q_start + block_q - 1)(_compute)
+        _causal_dispatch(_compute, q_start, k_start, block_q, block_k)
     else:
-        _compute()
+        _compute(False)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
@@ -263,21 +298,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_start = qi * block_q
     k_start = ki * block_k
 
-    def _compute():
+    def _compute(masked: bool):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0]
+        lse = lse_ref[0, 0] * LOG2E    # nat -> exp2 domain (per row)
         delta = delta_ref[0, 0]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * (
+            sm_scale * LOG2E)
+        if masked:
             q_idx = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_idx = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_idx >= k_idx, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse)
         dv_acc_ref[:] += jnp.dot(p.astype(do.dtype).T, do,
                                  preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
@@ -286,10 +322,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                  preferred_element_type=jnp.float32)
 
     if causal:
-        # For a kv block, only q blocks at or below the diagonal contribute.
-        pl.when(q_start + block_q - 1 >= k_start)(_compute)
+        # For a kv block, only q blocks at or below the diagonal
+        # contribute; blocks strictly below it need no mask.
+        _causal_dispatch(_compute, q_start, k_start, block_q, block_k)
     else:
-        _compute()
+        _compute(False)
 
     @pl.when(qi == num_q_blocks - 1)
     def _finalize():
@@ -319,6 +356,10 @@ def _flash_bwd(res, g, *, sm_scale: float, causal: bool,
         return (b, h, qi, 0)
 
     def kv_map(b, h, qi, ki):
+        if causal:
+            # dedupe the DMA of kv blocks above the diagonal (skipped by
+            # the kernel): same trick as the forward's kv_map
+            ki = jnp.minimum(ki, ((qi + 1) * block_q - 1) // block_k)
         return (b, h // group, ki, 0)
 
     def row_map(b, h, qi, ki):
@@ -343,6 +384,7 @@ def _flash_bwd(res, g, *, sm_scale: float, causal: bool,
         out_specs=pl.BlockSpec((1, 1, block_q, head_dim), q_map),
         out_shape=_sds(q.shape, q.dtype, _vma(q, k, v, do)),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        compiler_params=_DIM_SEMANTICS,
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
 
@@ -352,14 +394,30 @@ def _flash_bwd(res, g, *, sm_scale: float, causal: bool,
     def kv_out_map(b, h, ki, qi):
         return (b, h, ki, 0)
 
+    if causal:
+        # dedupe the DMA of q/do/lse/delta blocks strictly above the
+        # diagonal (skipped by the kernel): clamp to the first
+        # contributing q block for this kv block. The upper clamp keeps
+        # the index in range when seq_k > seq_q (trailing kv blocks have
+        # no contributing q block at all — the kernel skips them, but
+        # the index map must still be in bounds: on real TPU an OOB
+        # block DMAs undefined memory).
+        def _qi_eff(ki, qi):
+            return jnp.minimum(
+                jnp.maximum(qi, (ki * block_k) // block_q),
+                num_q_blocks - 1)
+    else:
+        def _qi_eff(ki, qi):
+            return qi
+
     def q_map2(b, h, ki, qi):
-        return (b, h, qi, 0)
+        return (b, h, _qi_eff(ki, qi), 0)
 
     def kv_map2(b, h, ki, qi):
         return (b, h // group, ki, 0)
 
     def row_map2(b, h, ki, qi):
-        return (b, h, qi, 0)
+        return (b, h, _qi_eff(ki, qi), 0)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
@@ -390,6 +448,7 @@ def _flash_bwd(res, g, *, sm_scale: float, causal: bool,
             pltpu.VMEM((block_k, head_dim), jnp.float32),
             pltpu.VMEM((block_k, head_dim), jnp.float32),
         ],
+        compiler_params=_DIM_SEMANTICS,
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
 

@@ -81,6 +81,19 @@ class MeshSpec:
         return cls(**sizes)
 
 
+def dcn_granules(devices) -> Tuple[int, bool]:
+    """(number of DCN granules, granule-is-process). Granules are SLICES
+    when the platform reports them (a multi-host single-slice pod is
+    all-ICI: plain topology assignment is correct there); otherwise each
+    process is its own DCN domain (CPU meshes, non-slice platforms).
+    Single source of the rule — the auto-planner's multi-slice detection
+    (auto/engine/analyser.py) must agree with the mesh it plans for."""
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None in slice_ids:
+        return len({getattr(d, "process_index", 0) for d in devices}), True
+    return len(slice_ids), False
+
+
 def _dcn_split(spec: MeshSpec, n_granules: int) -> Optional[List[int]]:
     """Split one mesh axis across the slow (DCN) fabric.
 
@@ -121,17 +134,7 @@ def create_mesh(spec: Optional[MeshSpec] = None,
     names = tuple(name for name, _ in spec.axis_sizes())
     shape = tuple(size for _, size in spec.axis_sizes())
 
-    # DCN granules are SLICES when the platform reports them (a
-    # multi-host single-slice pod is all-ICI: plain topology assignment
-    # is correct there); otherwise each process is its own DCN domain
-    # (CPU meshes, non-slice platforms).
-    slice_ids = {getattr(d, "slice_index", None) for d in devices}
-    if None in slice_ids:
-        n_granules = len({d.process_index for d in devices})
-        process_is_granule = True
-    else:
-        n_granules = len(slice_ids)
-        process_is_granule = False
+    n_granules, process_is_granule = dcn_granules(devices)
     array: Optional[np.ndarray] = None
     if n_granules > 1:
         dcn_shape = _dcn_split(spec, n_granules)

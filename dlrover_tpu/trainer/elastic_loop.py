@@ -201,7 +201,7 @@ class ElasticTrainLoop:
                     _time.monotonic() - t0, 2)
                 if sampler is not None and "sampler" in data_state:
                     sampler.load_state_dict(data_state["sampler"])
-                if self.client is not None and "shards" in data_state:
+                if self.client is not None and data_state.get("shards"):
                     try:
                         self.client.report_shard_checkpoint(
                             data_state["shards"])
@@ -318,7 +318,11 @@ class ElasticTrainLoop:
             data_state["sampler"] = sampler.state_dict()
         if self.client is not None:
             try:
-                data_state["shards"] = self.client.get_shard_checkpoint("")
+                shards = self.client.get_shard_checkpoint("")
+                # the master answers "" when no dataset is registered
+                # (purely local data): nothing to restore later
+                if shards:
+                    data_state["shards"] = shards
             except Exception:
                 pass
         return data_state

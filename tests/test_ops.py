@@ -93,6 +93,28 @@ class TestFlashAttentionBackward:
         for a, b in zip(g_flash, g_ref):
             np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
 
+    @pytest.mark.parametrize("seq_q,seq_k", [(64, 256), (256, 64)])
+    def test_cross_length_grads(self, seq_q, seq_k):
+        # seq_k > seq_q regression: the dkv DMA-dedupe clamp must stay
+        # within q's block range even for trailing kv blocks that have
+        # no contributing q block (OOB block indices DMA undefined
+        # memory on real TPU; interpret mode zero-pads, so this guards
+        # the index math itself).
+        q, _, _ = _qkv(seq=seq_q, dim=64)
+        _, k, v = _qkv(seq=seq_k, dim=64, seed=1)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True, None, 64, 64)
+                           ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, True) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
     def test_gqa_grads(self):
         q, k, v = _qkv(heads=4, kv_heads=2, seq=64, dim=64)
 
