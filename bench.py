@@ -137,7 +137,7 @@ def _timed_loop(step_fn, state, tok, tgt, warmup=2, steps=5):
     force the full chain to execute — necessary under remote-execution
     backends (block_until_ready does not wait on the axon tunnel).
     Returns (state, seconds, warmup_loss, final_loss)."""
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):   # >=1: warmup_loss needs a metrics
         state, metrics = step_fn(state, tok, tgt)
     warmup_loss = float(metrics["loss"])
     t0 = time.perf_counter()

@@ -207,10 +207,11 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
         # docs/benchmarks.md "Round-5 on-chip evidence"), so int8 is
         # now the default; BENCH_RESTORE_QUANT_BITS=0 reverts to the
         # exact-dtype baseline.
-        # pinned unconditionally (incl. "0"): the worker env overlays
-        # the ambient environment, and an exported
-        # DLROVER_TPU_CKPT_QUANT_BITS must not silently quantize the
-        # run that reports itself as the exact-dtype baseline
+        # pinned unconditionally: the worker env overlays the ambient
+        # environment, so the codec choice is governed ONLY by
+        # BENCH_RESTORE_QUANT_BITS — an exported
+        # DLROVER_TPU_CKPT_QUANT_BITS must not silently re-quantize a
+        # run explicitly reverted to the exact-dtype baseline with =0
         worker_env["DLROVER_TPU_CKPT_QUANT_BITS"] = os.environ.get(
             "BENCH_RESTORE_QUANT_BITS", "8")
     spec = WorkerSpec(
