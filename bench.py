@@ -180,10 +180,34 @@ def _seven_b_streaming() -> int:
     ≈ 14 GB, under the 15.75 GB that the dense step's full gradient
     tree (27 GB) overruns (VERDICT r4 item 3 / docs/benchmarks.md).
     AOT-compiles first and reports the XLA memory analysis either way,
-    so an OOM comes with the measured budget, not a guess."""
+    so an OOM comes with the measured budget, not a guess. micro 2
+    measures ~6.5% faster than micro 1 (0.586 vs 0.550 MFU on v5e) at
+    the same 15.48 GB analyzed peak; micro 1 stays as the fallback so a
+    tighter-HBM chip still produces a number instead of an OOM note —
+    with the micro-2 failure reason carried in the reported JSON
+    (``fallback_note``), not lost on a discarded stderr."""
+    try:
+        print(json.dumps(_seven_b_streaming_run(2, 2048)))
+        return 0
+    except Exception as e:
+        note = f"micro=2 failed ({str(e)[:300]}); fell back to micro=1"
+    try:
+        rec = _seven_b_streaming_run(1, 2048)
+        rec["fallback_note"] = note
+        print(json.dumps(rec))
+        return 0
+    except Exception as e:
+        return _oom_report(e, mode="streaming",
+                           memory=getattr(e, "bench_memory", {}),
+                           fallback_note=note)
+
+
+def _seven_b_streaming_run(micro: int, seq: int) -> dict:
+    """One streaming-7B attempt. Returns the result record; raises on
+    failure with the partial XLA memory analysis attached as
+    ``e.bench_memory`` so the caller's report keeps the evidence."""
     from dlrover_tpu.trainer.streaming import build_streaming_trainer
 
-    micro, seq = 1, 2048
     # untied embeddings — real Llama-7B has a separate lm_head; tying
     # would shave vocab·hidden params (~2%) and overstate the number
     cfg = LlamaConfig.llama_7b(
@@ -192,9 +216,9 @@ def _seven_b_streaming() -> int:
         param_dtype=jnp.bfloat16)
     tx = optax.chain(optax.scale_by_factored_rms(),
                      optax.scale(-3e-4))
-    trainer = build_streaming_trainer(cfg, tx, micro, seq)
     mem: dict = {}
     try:
+        trainer = build_streaming_trainer(cfg, tx, micro, seq)
         abstract = trainer.abstract_state(jax.random.PRNGKey(0))
         tok_abs = jax.ShapeDtypeStruct((micro, seq), jnp.int32)
         compiled = trainer.step_fn.lower(
@@ -220,12 +244,12 @@ def _seven_b_streaming() -> int:
         tokens_per_sec = micro * seq * steps / dt
         mfu = (tokens_per_sec * _model_flops_per_token(cfg, seq)
                / peak_flops(jax.devices()[0]))
-        print(json.dumps({"tokens_per_sec": round(tokens_per_sec, 1),
-                          "mfu": round(mfu, 4), "mode": "streaming",
-                          "memory": mem}))
-        return 0
+        return {"tokens_per_sec": round(tokens_per_sec, 1),
+                "mfu": round(mfu, 4), "mode": "streaming",
+                "micro_batch": micro, "memory": mem}
     except Exception as e:
-        return _oom_report(e, mode="streaming", memory=mem)
+        e.bench_memory = mem
+        raise
 
 
 def seven_b_main() -> int:
@@ -277,9 +301,12 @@ def seven_b_main() -> int:
         return _oom_report(e)
 
 
-def run_7b_bench(timeout_s: float = 900.0) -> dict:
+def run_7b_bench(timeout_s: float = 1800.0) -> dict:
     """Run the --llama7b attempt in its own process (it must own the
-    TPU; a failure must not kill the headline bench)."""
+    TPU; a failure must not kill the headline bench). The budget is 2x
+    the old single-attempt 900 s: a micro-2 attempt that fails late
+    (post-compile) plus the full micro-1 fallback is two on-chip
+    compiles and two timed runs, each bounded by the old worst case."""
     return _run_json_subprocess(
         [sys.executable, os.path.abspath(__file__), "--llama7b"],
         timeout_s)
@@ -449,8 +476,13 @@ def main() -> None:
             "tokens_per_sec", -1.0)
         if "mfu" in llama7b:
             result["llama7b_mfu"] = llama7b["mfu"]
-        if "error" in llama7b:
-            result["llama7b_note"] = llama7b["error"]
+        if "micro_batch" in llama7b:
+            # a micro-1 value here means the micro-2 default fell back —
+            # visible in the scoreboard, not just the subprocess log
+            result["llama7b_micro_batch"] = llama7b["micro_batch"]
+        for key in ("error", "fallback_note"):
+            if key in llama7b:
+                result["llama7b_note"] = llama7b[key]
     if tpu_unreachable:
         result["tpu_unreachable"] = True
         result["unit"] += " [TPU tunnel unreachable: CPU fallback]"
