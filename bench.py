@@ -480,9 +480,10 @@ def main() -> None:
             # a micro-1 value here means the micro-2 default fell back —
             # visible in the scoreboard, not just the subprocess log
             result["llama7b_micro_batch"] = llama7b["micro_batch"]
-        for key in ("error", "fallback_note"):
-            if key in llama7b:
-                result["llama7b_note"] = llama7b[key]
+        notes = [llama7b[k] for k in ("error", "fallback_note")
+                 if k in llama7b]
+        if notes:   # both attempts failing keeps BOTH reasons visible
+            result["llama7b_note"] = " | ".join(notes)
     if tpu_unreachable:
         result["tpu_unreachable"] = True
         result["unit"] += " [TPU tunnel unreachable: CPU fallback]"

@@ -154,6 +154,44 @@ def lower(context: ModelContext) -> AccelerateResult:
         micro = plan.micro_batch or dp
     sample = context.infer_sample_batch(micro)
 
+    if plan.streaming:
+        from dlrover_tpu.models.llama import (
+            LlamaConfig,
+            cross_entropy_loss,
+        )
+        from dlrover_tpu.trainer.streaming import build_streaming_trainer
+
+        if n_devices > 1 or plan.pipeline_stages > 1:
+            raise ValueError(
+                "streaming is the single-device >HBM escape hatch; on "
+                f"{n_devices} devices use fsdp / pipeline_parallel "
+                "instead (they shard the gradient tree across chips)")
+        if accum > 1:
+            raise ValueError(
+                f"streaming cannot gradient-accumulate (accum={accum}): "
+                "holding the accumulated full-tree gradients is exactly "
+                "the >HBM cost streaming exists to avoid — raise "
+                "micro_batch (or drop global_batch) so accum == 1")
+        cfg = context.model_config()
+        if not isinstance(cfg, LlamaConfig):
+            raise NotImplementedError(
+                "streaming lowering needs the scan-shaped Llama stack "
+                "(LlamaConfig); for custom models call "
+                "dlrover_tpu.trainer.streaming.build_streaming_trainer "
+                "with a compatible per-layer model directly")
+        if context.loss_fn not in (None, cross_entropy_loss):
+            logger.warning(
+                "streaming computes its own chunked cross-entropy head "
+                "loss; the provided loss_fn is ignored")
+        trainer = build_streaming_trainer(
+            cfg, context.make_optimizer(),
+            micro_batch=micro,
+            seq_len=int(np.asarray(sample).shape[-1]),
+        )
+        return AccelerateResult(trainer=trainer, mesh=mesh,
+                                model=context.model, strategy=[],
+                                context=context)
+
     if plan.pipeline_stages > 1:
         from dlrover_tpu.models.bert import BertConfig
         from dlrover_tpu.models.gpt import GPTConfig

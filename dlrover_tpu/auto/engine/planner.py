@@ -140,6 +140,13 @@ def plan_candidates(context: ModelContext,
         # (and an fsdp alternative the dry-run can score)
         extras.append([("half", {}), ("module_replace", {}),
                        ("offload_optimizer", {})])
+        if n_devices == 1:
+            # offload alone can't save a model whose params+grads exceed
+            # HBM — the streaming per-layer trainer caps peak at params
+            # + one layer's grads (per-leaf-optimizer contract logged by
+            # the pass; the dry-run scores it like any candidate)
+            extras.append([("half", {}), ("module_replace", {}),
+                           ("streaming", {})])
 
     # smallest first: baseline (forced only), then singles, then pairs, ...
     for size in range(0, len(optional) + 1):

@@ -50,6 +50,10 @@ class AccelerationPlan:
     grad_reduce_bits: int = 0
     # 1F1B-style live-activation bound for PP (checkpointed windows)
     pipeline_bound_activations: bool = False
+    # per-layer streaming backward+update: >HBM models on ONE device
+    # (reference: FSDP param/grad sharding + adam_offload are its
+    # multi-device / host-memory analogs)
+    streaming: bool = False
     extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 

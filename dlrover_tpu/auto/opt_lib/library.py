@@ -119,6 +119,29 @@ class OffloadOptimizerOptimization(Optimization):
         context.plan.offload_optimizer = True
 
 
+class StreamingOptimization(Optimization):
+    """Per-layer streaming backward+update: train models whose FULL
+    gradient tree exceeds one device's HBM (reference capability:
+    FSDP param/grad sharding, atorch/distributed/zero_optimization.py:215,
+    and CPU-offloaded Adam, atorch/optim/adam_offload.py — this is the
+    single-chip TPU analog). The backward runs as a reverse per-layer
+    loop applying the optimizer update in place, so peak memory is
+    params + ONE layer's gradients (trainer/streaming.py).
+
+    Contract: scan-shaped Llama stack + a PER-LEAF optimizer
+    (factored_rms/adafactor/adam qualify; global-norm clipping does
+    not — its norm would be per-layer, changing the math)."""
+
+    name = "streaming"
+
+    def apply(self, context, config):
+        context.plan.streaming = True
+        logger.info(
+            "streaming: per-layer backward+update — the optimizer must "
+            "be per-leaf (factored_rms/adafactor; global-norm clipping "
+            "would silently become per-layer clipping)")
+
+
 class QuantizedAllreduceOptimization(Optimization):
     """int8/int4 groupwise gradient all-reduce over the data/DCN axis
     (reference: the quant_reduce CUDA kernel,
@@ -260,6 +283,7 @@ class OptimizationLibrary:
             ThreeDParallelOptimization,
             OffloadOptimizerOptimization,
             QuantizedAllreduceOptimization,
+            StreamingOptimization,
         ):
             opt = opt_cls()
             self.opts[opt.name] = opt
