@@ -187,8 +187,9 @@ def lower(context: ModelContext) -> AccelerateResult:
             cfg, context.make_optimizer(),
             micro_batch=micro,
             seq_len=int(np.asarray(sample).shape[-1]),
+            devices=context.devices,
         )
-        return AccelerateResult(trainer=trainer, mesh=mesh,
+        return AccelerateResult(trainer=trainer, mesh=trainer.mesh,
                                 model=context.model, strategy=[],
                                 context=context)
 

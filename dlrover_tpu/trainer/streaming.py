@@ -63,6 +63,14 @@ class StreamingState:
     head_opt: Any
     norm_opt: Any
 
+    @property
+    def params(self) -> Any:
+        """Parameter subtree (TrainState.params parity) so generic
+        consumers — e.g. the elastic loop's model-info report — can
+        size the model without knowing the streaming layout."""
+        return {"blocks": self.block_params, "embed": self.embed,
+                "head": self.head, "norm": self.norm_params}
+
 
 def _tree_index(tree: Any, i) -> Any:
     return jax.tree.map(
@@ -79,7 +87,10 @@ def _tree_update(tree: Any, leaf_tree: Any, i) -> Any:
 
 @dataclasses.dataclass
 class StreamingTrainer:
-    """Mirror of ShardedTrainer's surface for the streaming step."""
+    """Mirror of ShardedTrainer's surface for the streaming step —
+    including `mesh` and `precompile()`, so ElasticTrainLoop can take it
+    as an injected trainer (checkpoint/resume, restore-compile overlap,
+    speed reports all apply unchanged)."""
 
     config: LlamaConfig
     init_fn: Callable[[jax.Array], StreamingState]
@@ -87,6 +98,9 @@ class StreamingTrainer:
     micro_batch: int
     seq_len: int
     accum_steps: int = 1
+    mesh: Any = None                 # single-device mesh
+    precompile_timings: dict = dataclasses.field(default_factory=dict)
+    _compiled: Any = None
 
     def init(self, rng: jax.Array) -> StreamingState:
         return self.init_fn(rng)
@@ -94,7 +108,31 @@ class StreamingTrainer:
     def abstract_state(self, rng: jax.Array) -> StreamingState:
         return jax.eval_shape(self.init_fn, rng)
 
+    def precompile(self, rng: Optional[jax.Array] = None) -> None:
+        """AOT-compile the step for the built shapes (idempotent); the
+        elastic loop calls this concurrently with the checkpoint read."""
+        if self._compiled is not None:
+            return
+        import time as _time
+
+        t0 = _time.monotonic()
+        abstract = self.abstract_state(
+            rng if rng is not None else jax.random.PRNGKey(0))
+        tok = jax.ShapeDtypeStruct((self.micro_batch, self.seq_len),
+                                   jnp.int32)
+        self._compiled = self.step_fn.lower(abstract, tok, tok).compile()
+        self.precompile_timings = {
+            "streaming_aot_s": round(_time.monotonic() - t0, 2)}
+
     def step(self, state: StreamingState, tokens, targets):
+        # the AOT executable is shape-pinned; any other shape (shorter
+        # final batch, a longer sequence) takes the jitted path, which
+        # retraces — the head-loss chunking derives from the runtime
+        # length, so other sequence lengths stay supported
+        if (self._compiled is not None
+                and tuple(tokens.shape) == (self.micro_batch,
+                                            self.seq_len)):
+            return self._compiled(state, tokens, targets)
         return self.step_fn(state, tokens, targets)
 
     def shard_batch(self, tokens, targets):
@@ -107,6 +145,7 @@ def build_streaming_trainer(
     micro_batch: int,
     seq_len: int,
     rng_seed: int = 0,
+    devices: Any = None,
 ) -> StreamingTrainer:
     """Lower a scan-shaped Llama + per-leaf optimizer into a streaming
     step. Single-device oriented (the >HBM single-chip escape hatch);
@@ -286,10 +325,15 @@ def build_streaming_trainer(
         )
         return new_state, {"loss": loss}
 
+    from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
+
     return StreamingTrainer(
         config=cfg,
         init_fn=jax.jit(_init),
         step_fn=jax.jit(_step, donate_argnums=(0,)),
         micro_batch=micro_batch,
         seq_len=seq_len,
+        mesh=create_mesh(
+            MeshSpec(),
+            (devices if devices is not None else jax.devices())[:1]),
     )

@@ -184,3 +184,38 @@ def test_moe_expert_parallel_standalone(tmp_path):
     lines = open(log2).read()
     assert "start_step=4" in lines
     assert "done step=6" in lines
+
+
+TRAIN_STREAMING = os.path.join(REPO, "examples", "streaming", "train.py")
+
+
+def test_streaming_standalone_trains_and_resumes(tmp_path):
+    """The streaming (>HBM per-layer) example through the real CLI:
+    auto_accelerate's `streaming` strategy lowers to the injected
+    StreamingTrainer, trains, checkpoints, and a second run resumes
+    from the saved step with the sampler position intact."""
+    ckpt = str(tmp_path / "ckpt")
+    log1 = str(tmp_path / "run1.log")
+    proc = run_cli(tmp_path, [
+        "--steps", "4", "--save-interval", "2",
+        "--batch", "2", "--seq", "64",
+        "--hidden", "64", "--layers", "2",
+        "--ckpt-dir", ckpt, "--log-file", log1,
+    ], script=TRAIN_STREAMING, timeout=360)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = open(log1).read()
+    assert "start_step=0" in lines
+    assert "done step=4" in lines
+    assert os.path.isdir(ckpt) and os.listdir(ckpt)
+
+    log2 = str(tmp_path / "run2.log")
+    proc = run_cli(tmp_path, [
+        "--steps", "6", "--save-interval", "2",
+        "--batch", "2", "--seq", "64",
+        "--hidden", "64", "--layers", "2",
+        "--ckpt-dir", ckpt, "--log-file", log2,
+    ], script=TRAIN_STREAMING, timeout=360)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = open(log2).read()
+    assert "start_step=4" in lines
+    assert "done step=6" in lines
