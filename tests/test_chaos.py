@@ -58,6 +58,31 @@ class TestChaosSpec:
         assert sleeps == [5.0, 0.5, 0.5]         # slow: every step
 
 
+def _run_chaos_job(tmp_path, script, train_args,
+                   spec="kill:worker:0@3", marker="chaos_kill_worker_0_3"):
+    """Launch a real CLI job with a kill fault armed, return the worker
+    log contents after the job completes. The kill fires once per JOB
+    (state dir); the fired marker keeps the fault from replaying into
+    the respawn."""
+    ckpt = str(tmp_path / "ckpt")
+    log = str(tmp_path / "chaos.log")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DLROVER_TPU_CHAOS"] = spec
+    env["DLROVER_TPU_CHAOS_STATE"] = str(tmp_path / "chaos_state")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.run", "--standalone",
+         "--devices-per-node", "1", "--monitor-interval", "0.2",
+         "--max-restarts", "2",
+         script, "--steps", "6", "--save-interval", "2",
+         "--ckpt-dir", ckpt, "--log-file", log] + train_args,
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert os.path.exists(str(tmp_path / "chaos_state" / marker))
+    return open(log).read()
+
+
 @pytest.mark.e2e
 def test_scripted_chaos_kill_recovers(tmp_path):
     """The chaos-run twin of the reference's start_chaos.sh: launch the
@@ -65,30 +90,40 @@ def test_scripted_chaos_kill_recovers(tmp_path):
     step 3, the agent respawns it, the second incarnation completes the
     job (resuming from the step-2 checkpoint when its async commit won
     the race with the kill)."""
-    ckpt = str(tmp_path / "ckpt")
-    log = str(tmp_path / "chaos.log")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # kill fires once per JOB (state dir) at step 3 — one step after the
-    # step-2 checkpoint save kicked off
-    env["DLROVER_TPU_CHAOS"] = "kill:worker:0@3"
-    env["DLROVER_TPU_CHAOS_STATE"] = str(tmp_path / "chaos_state")
-    proc = subprocess.run(
-        [sys.executable, "-m", "dlrover_tpu.run", "--standalone",
-         "--devices-per-node", "1", "--monitor-interval", "0.2",
-         "--max-restarts", "2",
-         TRAIN, "--steps", "6", "--save-interval", "2",
-         "--global-batch", "8", "--seq", "32",
-         "--ckpt-dir", ckpt, "--log-file", log],
-        env=env, cwd=REPO, capture_output=True, text=True, timeout=420,
-    )
-    assert proc.returncode == 0, proc.stderr[-2000:]
-    lines = open(log).read()
+    lines = _run_chaos_job(tmp_path, TRAIN,
+                           ["--global-batch", "8", "--seq", "32"])
     # exactly two incarnations: the original (killed by the fault) and
-    # one respawn that completes; the fired marker keeps the fault from
-    # replaying into the respawn
+    # one respawn that completes
     assert lines.count("start_step=") == 2, lines
     assert "start_step=0" in lines
     assert "done step=6" in lines
-    assert os.path.exists(
-        str(tmp_path / "chaos_state" / "chaos_kill_worker_0_3"))
+
+
+@pytest.mark.e2e
+def test_chaos_kill_recovers_streaming_trainer(tmp_path):
+    """Kill-recovery for the streaming (>HBM per-layer) path: the chaos
+    fault SIGKILLs the streaming worker mid-run, the agent respawns it,
+    and the respawn restores StreamingState (params + per-layer
+    optimizer moments + sampler position) from the async checkpoint and
+    completes — the full elastic story for the single-chip big-model
+    trainer."""
+    train_streaming = os.path.join(REPO, "examples", "streaming",
+                                   "train.py")
+    # the respawn must RESUME (restore StreamingState from the step-2
+    # checkpoint), not retrain from scratch — so the kill cannot race
+    # the async step-2 commit: steps are milliseconds on this tiny
+    # model, so a bare kill@3 fires before the commit lands. A slow
+    # fault at step 3 buys the commit 1.5 s of wall time; the kill
+    # fires at step 4 (before step 4's own save is reached).
+    lines = _run_chaos_job(
+        tmp_path, train_streaming,
+        ["--batch", "2", "--seq", "64",
+         "--hidden", "64", "--layers", "2"],
+        spec="slow:worker:0@3:1.5;kill:worker:0@4",
+        marker="chaos_kill_worker_0_4")
+    assert lines.count("start_step=") == 2, lines
+    assert "done step=6" in lines
+    # a second start_step=0 would mean the restore path is dead while
+    # everything else still passes
+    assert lines.count("start_step=0") == 1, lines
+    assert "start_step=2" in lines
