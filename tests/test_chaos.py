@@ -58,8 +58,16 @@ class TestChaosSpec:
         assert sleeps == [5.0, 0.5, 0.5]         # slow: every step
 
 
+# slow@3 buys the step-2 async checkpoint commit 1.5 s of wall time
+# before the step-4 kill (steps on these tiny models are milliseconds —
+# a bare kill one step after the save reliably beats the commit, making
+# resume nondeterministic)
+_KILL_SPEC = "slow:worker:0@3:1.5;kill:worker:0@4"
+_KILL_MARKER = "chaos_kill_worker_0_4"
+
+
 def _run_chaos_job(tmp_path, script, train_args,
-                   spec="kill:worker:0@3", marker="chaos_kill_worker_0_3"):
+                   spec=_KILL_SPEC, marker=_KILL_MARKER):
     """Launch a real CLI job with a kill fault armed, return the worker
     log contents after the job completes. The kill fires once per JOB
     (state dir); the fired marker keeps the fault from replaying into
@@ -86,16 +94,17 @@ def _run_chaos_job(tmp_path, script, train_args,
 @pytest.mark.e2e
 def test_scripted_chaos_kill_recovers(tmp_path):
     """The chaos-run twin of the reference's start_chaos.sh: launch the
-    real CLI job with a kill fault armed; the worker SIGKILLs itself at
-    step 3, the agent respawns it, the second incarnation completes the
-    job (resuming from the step-2 checkpoint when its async commit won
-    the race with the kill)."""
-    lines = _run_chaos_job(tmp_path, TRAIN,
-                           ["--global-batch", "8", "--seq", "32"])
+    real CLI job with a kill fault armed; the worker SIGKILLs itself,
+    the agent respawns it, and the second incarnation RESUMES from the
+    step-2 checkpoint (the slow fault at step 3 buys the async commit
+    wall time before the step-4 kill — see the streaming twin below)."""
+    lines = _run_chaos_job(
+        tmp_path, TRAIN, ["--global-batch", "8", "--seq", "32"])
     # exactly two incarnations: the original (killed by the fault) and
-    # one respawn that completes
+    # one respawn that resumes and completes
     assert lines.count("start_step=") == 2, lines
-    assert "start_step=0" in lines
+    assert lines.count("start_step=0") == 1, lines
+    assert "start_step=2" in lines
     assert "done step=6" in lines
 
 
@@ -109,18 +118,10 @@ def test_chaos_kill_recovers_streaming_trainer(tmp_path):
     trainer."""
     train_streaming = os.path.join(REPO, "examples", "streaming",
                                    "train.py")
-    # the respawn must RESUME (restore StreamingState from the step-2
-    # checkpoint), not retrain from scratch — so the kill cannot race
-    # the async step-2 commit: steps are milliseconds on this tiny
-    # model, so a bare kill@3 fires before the commit lands. A slow
-    # fault at step 3 buys the commit 1.5 s of wall time; the kill
-    # fires at step 4 (before step 4's own save is reached).
     lines = _run_chaos_job(
         tmp_path, train_streaming,
         ["--batch", "2", "--seq", "64",
-         "--hidden", "64", "--layers", "2"],
-        spec="slow:worker:0@3:1.5;kill:worker:0@4",
-        marker="chaos_kill_worker_0_4")
+         "--hidden", "64", "--layers", "2"])
     assert lines.count("start_step=") == 2, lines
     assert "done step=6" in lines
     # a second start_step=0 would mean the restore path is dead while
