@@ -16,7 +16,6 @@ from typing import Dict, Optional, Tuple
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.comm import MasterStub, build_channel, local_ip
 from dlrover_tpu.common.constants import NodeEnv, RendezvousName
-from dlrover_tpu.common.log import default_logger as logger
 
 
 def retry_rpc(retries: int = 10, backoff_s: float = 1.0):

@@ -1,0 +1,35 @@
+"""graftlint: trace-safety + lock-discipline static analysis.
+
+Two AST passes purpose-built for this codebase's failure modes:
+
+- trace-safety (GL1xx): jitted step functions must be retrace-safe and
+  donation-correct — elastic resharding breaks first at silent
+  recompilation/donation bugs.
+- lock-discipline (GL2xx): the threaded master/agent components must
+  follow a consistent lock discipline or failover races in exactly the
+  window a chaos kill opens.
+
+Entry points: ``tools/graftlint.py`` (CLI + CI gate),
+``run_analysis`` (library), ``tests/test_graftlint.py`` (tier-1 gate).
+See docs/static_analysis.md for the rule catalog.
+"""
+
+from dlrover_tpu.analysis.findings import (       # noqa: F401
+    Finding,
+    RULES,
+    Rule,
+    distinct_rule_ids,
+)
+from dlrover_tpu.analysis.lock_discipline import (  # noqa: F401
+    LockDisciplinePass,
+)
+from dlrover_tpu.analysis.runner import (         # noqa: F401
+    AnalysisResult,
+    analyze_file,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+from dlrover_tpu.analysis.trace_safety import (   # noqa: F401
+    TraceSafetyPass,
+)

@@ -1,0 +1,178 @@
+"""Finding/Rule model shared by the graftlint passes.
+
+A Finding carries (rule_id, file:line, message, symbol) plus a *stable
+fingerprint* — a hash of everything EXCEPT the line number, so a checked-in
+baseline (tools/graftlint_baseline.json) keeps suppressing a pre-existing
+violation while unrelated edits shift it around the file. Inline
+suppression follows the pylint convention::
+
+    risky_call()  # graftlint: disable=GL102
+
+and ``# graftlint: skip-file`` anywhere in the first 5 lines exempts a
+module (for generated or vendored code; the test fixtures do NOT use it —
+their deliberate violations must stay visible to the fixture tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import re
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    pass_name: str            # "trace-safety" | "lock-discipline"
+    title: str
+    hint: str
+
+
+RULES: Dict[str, Rule] = {
+    r.rule_id: r
+    for r in [
+        Rule(
+            "GL101", "trace-safety",
+            "Python control flow on a traced value",
+            "branching on a tracer raises TracerBoolConversionError or "
+            "forces a retrace per value; use jax.lax.cond/select/while_loop "
+            "or mark the argument static (static_argnums).",
+        ),
+        Rule(
+            "GL102", "trace-safety",
+            "impure call inside traced code",
+            "time/np.random/os.environ/print run ONCE at trace time and "
+            "bake a constant into the compiled program (silently stale "
+            "after elastic re-lowering); thread jax.random keys, pass "
+            "clocks as arguments, use jax.debug.print.",
+        ),
+        Rule(
+            "GL103", "trace-safety",
+            "mutation of enclosing state inside traced code",
+            "writes to globals/closures/self from a traced function happen "
+            "at trace time, not per step — they replay on every retrace "
+            "and never on cached executions; return the value instead.",
+        ),
+        Rule(
+            "GL104", "trace-safety",
+            "state-threading jit without buffer donation",
+            "a step that takes state and returns updated state holds BOTH "
+            "copies in HBM without donate_argnums; pass "
+            "donate_argnums/donate_argnames IF callers rebind the "
+            "returned state (they must not reuse the donated input); "
+            "otherwise suppress with `# graftlint: disable=GL104`.",
+        ),
+        Rule(
+            "GL105", "trace-safety",
+            "blocking host sync inside the training hot loop",
+            "device_get/block_until_ready inside the step loop stalls the "
+            "XLA dispatch pipeline every iteration; sync outside the loop "
+            "or on an interval.",
+        ),
+        Rule(
+            "GL201", "lock-discipline",
+            "unguarded access to a lock-protected attribute",
+            "this attribute is accessed under the class lock almost "
+            "everywhere else; take the lock here too (a race in exactly "
+            "the window a failover opens).",
+        ),
+        Rule(
+            "GL202", "lock-discipline",
+            "inconsistent lock acquisition order",
+            "two locks are nested in both orders; pick one global order "
+            "(or merge the critical sections) to rule out deadlock.",
+        ),
+        Rule(
+            "GL203", "lock-discipline",
+            "blocking call while holding a lock",
+            "sleep/subprocess/network inside a critical section stalls "
+            "every thread contending for the lock (agents block on master "
+            "RPCs exactly during failover); move the slow call outside.",
+        ),
+        Rule(
+            "GL204", "lock-discipline",
+            "bare lock acquire() outside a with-statement",
+            "a no-argument acquire() leaks the lock on any exception "
+            "path; use `with lock:` (timed/non-blocking acquires with "
+            "arguments are exempt — pair those with try/finally).",
+        ),
+        Rule(
+            "GL205", "lock-discipline",
+            "multi-writer attribute never guarded in a lock-owning class",
+            "several methods of a class that owns a lock write this "
+            "attribute, but no access ever holds a lock — either guard it "
+            "or document why it is single-threaded.",
+        ),
+    ]
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule_id: str
+    path: str                 # package-relative posix path
+    line: int
+    col: int
+    message: str
+    symbol: str = ""          # enclosing function/class qualname
+
+    @property
+    def rule(self) -> Rule:
+        return RULES[self.rule_id]
+
+    def fingerprint(self, source_line: str = "") -> str:
+        norm = re.sub(r"\s+", " ", source_line.strip())
+        raw = f"{self.rule_id}|{self.path}|{self.symbol}|{norm}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule_id} "
+                f"[{self.rule.pass_name}] {self.message}\n"
+                f"    hint: {self.rule.hint}")
+
+
+_PRAGMA_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9, ]+)")
+_SKIP_FILE_RE = re.compile(r"#\s*graftlint:\s*skip-file")
+
+
+def file_skipped(source_lines: Sequence[str]) -> bool:
+    return any(_SKIP_FILE_RE.search(ln) for ln in source_lines[:5])
+
+
+def line_pragmas(source_lines: Sequence[str]) -> Dict[int, set]:
+    """1-based line -> set of rule ids disabled on that line."""
+    out: Dict[int, set] = {}
+    for i, ln in enumerate(source_lines, start=1):
+        m = _PRAGMA_RE.search(ln)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def apply_pragmas(findings: List[Finding],
+                  source_lines: Sequence[str]) -> List[Finding]:
+    pragmas = line_pragmas(source_lines)
+    kept = []
+    for f in findings:
+        disabled = pragmas.get(f.line, set())
+        if f.rule_id in disabled or "ALL" in disabled:
+            continue
+        kept.append(f)
+    return kept
+
+
+def source_line(source_lines: Sequence[str], line: int) -> str:
+    if 1 <= line <= len(source_lines):
+        return source_lines[line - 1]
+    return ""
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule_id))
+
+
+def distinct_rule_ids(findings: Optional[List[Finding]] = None) -> List[str]:
+    if findings is None:
+        return sorted(RULES)
+    return sorted({f.rule_id for f in findings})

@@ -1,0 +1,555 @@
+"""Pass 2: lockset analysis over threaded master/agent classes.
+
+For every class owning a ``threading.Lock``/``RLock``/``Condition`` (and
+for module-level locks), the pass tracks which locks are lexically held at
+every ``self.<attr>`` access, *learns* which lock guards which attribute
+from majority usage, and reports:
+
+GL201  an access to an attribute that is guarded almost everywhere else,
+       made without the lock.
+GL202  two locks nested in both orders anywhere in the module (deadlock).
+GL203  a blocking call (sleep / subprocess / HTTP / thread join) made
+       while holding a lock.
+GL204  a bare ``lock.acquire()`` outside a ``with`` statement.
+GL205  an attribute written by several methods of a lock-owning class
+       that is *never* accessed under any lock.
+
+The codebase convention "helper with the lock held" (private methods
+called only from inside critical sections, e.g.
+``RendezvousManager._cut_round``) is handled interprocedurally: a private
+method's *entry lockset* is the intersection of the locksets at its
+internal call sites, computed to fixpoint, and classes are merged with
+their same-module base classes so inherited helpers see subclass call
+sites too.
+
+Accesses inside nested ``def``s (thread targets, closures) are analyzed
+with an EMPTY lockset — they run later, on another thread.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dlrover_tpu.analysis.findings import Finding
+from dlrover_tpu.analysis.trace_safety import (
+    _dotted_name,
+    _import_aliases,
+)
+
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+_BLOCKING_EXACT = {"time.sleep"}
+_BLOCKING_PREFIX = ("subprocess.", "requests.", "urllib.request.",
+                    "socket.create_connection")
+_THREADY = ("thread", "proc", "worker", "server")
+_SKIP_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
+
+# guard inference thresholds: an attribute is "guarded by L" when at least
+# _MIN_GUARDED accesses hold L and they are at least _GUARDED_RATIO of all
+# accesses outside __init__
+_MIN_GUARDED = 2
+_GUARDED_RATIO = 0.75
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    held: Tuple[str, ...]
+    line: int
+    col: int
+    method: str
+    is_write: bool
+    in_nested_def: bool
+
+
+@dataclasses.dataclass
+class _CallSite:
+    callee: str               # bare method name
+    held: Tuple[str, ...]
+    caller: str
+
+
+class _ModuleOwner:
+    """Duck-typed _ClassFamily stand-in for module-level functions: no
+    instance attrs or methods, only module-level locks resolve."""
+
+    def __init__(self, aliases: Dict[str, str], module_locks: Set[str]):
+        self.aliases = aliases
+        self.module_locks = module_locks
+        self.lock_attrs: Set[str] = set()
+        self.method_names: Set[str] = set()
+
+    def lock_id(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"<module>.{expr.id}"
+        return None
+
+
+def _module_lock_names(tree: ast.Module,
+                       aliases: Dict[str, str]) -> Set[str]:
+    """Names bound to threading lock objects at module scope."""
+    names: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            head = _dotted_name(node.value.func, aliases)
+            if head in _LOCK_FACTORIES:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+    return names
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Walk one method body tracking the lexically-held lock stack."""
+
+    def __init__(self, owner: "_ClassFamily", method_name: str):
+        self.owner = owner
+        self.method = method_name
+        self.held: List[str] = []
+        self.accesses: List[_Access] = []
+        self.calls: List[_CallSite] = []
+        self.order_pairs: List[Tuple[str, str, ast.AST, str]] = []
+        self.blocking: List[Tuple[str, ast.Call, Tuple[str, ...]]] = []
+        self.bare_acquires: List[ast.Call] = []
+        self._nested_depth = 0
+
+    # -- helpers -----------------------------------------------------------
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        return self.owner.lock_id(expr)
+
+    # -- visitors ----------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            lock = self._lock_id(item.context_expr)
+            if lock is not None:
+                for outer in self.held:
+                    if outer != lock:
+                        self.order_pairs.append(
+                            (outer, lock, item.context_expr, self.method))
+                self.held.append(lock)
+                pushed += 1
+            else:
+                # `with self._lock, open(self._path):` — item i runs with
+                # the locks of items < i already acquired
+                self.visit(item.context_expr)
+                if item.optional_vars is not None:
+                    self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scan_nested(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._scan_nested(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._scan_nested(node)
+
+    def _scan_nested(self, node: ast.AST) -> None:
+        """A nested def runs later (often on another thread): empty
+        lockset, and its accesses don't inherit the method entry set."""
+        saved, self.held = self.held, []
+        self._nested_depth += 1
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self._nested_depth -= 1
+        self.held = saved
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        root = node.value
+        if isinstance(root, ast.Name) and root.id in ("self", "cls"):
+            attr = node.attr
+            if (attr not in self.owner.lock_attrs
+                    and attr not in self.owner.method_names):
+                self.accesses.append(_Access(
+                    attr=attr,
+                    held=tuple(self.held),
+                    line=node.lineno, col=node.col_offset,
+                    method=self.method,
+                    is_write=isinstance(node.ctx, (ast.Store, ast.Del)),
+                    in_nested_def=self._nested_depth > 0,
+                ))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        # self.method(...) / super().method(...) -> propagation edge
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            is_self = isinstance(base, ast.Name) and base.id in ("self",
+                                                                 "cls")
+            is_super = (isinstance(base, ast.Call)
+                        and isinstance(base.func, ast.Name)
+                        and base.func.id == "super")
+            if (is_self or is_super) and \
+                    func.attr in self.owner.method_names:
+                if self._nested_depth == 0:
+                    self.calls.append(_CallSite(
+                        callee=func.attr, held=tuple(self.held),
+                        caller=self.method))
+            if (func.attr == "acquire"
+                    and self._lock_id(base) is not None
+                    and not node.args and not node.keywords):
+                # acquire(timeout=...) / acquire(blocking=False) cannot be
+                # expressed as a `with` statement — only the bare form is
+                # the discipline violation
+                self.bare_acquires.append(node)
+        if self.held:
+            name = self._blocking_name(node)
+            if name:
+                self.blocking.append((name, node, tuple(self.held)))
+        self.generic_visit(node)
+
+    def _blocking_name(self, node: ast.Call) -> Optional[str]:
+        head = _dotted_name(node.func, self.owner.aliases)
+        if head in _BLOCKING_EXACT:
+            return head
+        if head and head.startswith(_BLOCKING_PREFIX):
+            return head
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join":
+            recv = node.func.value
+            text = ""
+            if isinstance(recv, ast.Attribute):
+                text = recv.attr
+            elif isinstance(recv, ast.Name):
+                text = recv.id
+            if any(t in text.lower() for t in _THREADY):
+                return f"{text}.join"
+        return None
+
+
+class _ClassFamily:
+    """A class merged with its same-module base classes."""
+
+    def __init__(self, name: str, classes: List[ast.ClassDef],
+                 aliases: Dict[str, str], relpath: str,
+                 module_locks: Optional[Set[str]] = None):
+        self.name = name
+        self.classes = classes
+        self.aliases = aliases
+        self.relpath = relpath
+        self.module_locks = module_locks or set()
+        self.lock_attrs: Set[str] = set()
+        self.method_names: Set[str] = set()
+        self.methods: List[Tuple[ast.ClassDef, ast.FunctionDef]] = []
+        for cls in classes:
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self.method_names.add(item.name)
+                    self.methods.append((cls, item))
+                elif isinstance(item, ast.Assign):
+                    # class-level lock (e.g. Context._lock)
+                    if self._is_lock_factory(item.value):
+                        for tgt in item.targets:
+                            if isinstance(tgt, ast.Name):
+                                self.lock_attrs.add(tgt.id)
+        # instance attributes: anything ever STORED via self.X/cls.X —
+        # class-body constants (e.g. `name = "base"`) never race and are
+        # excluded from guard inference
+        self.instance_attrs: Set[str] = set()
+        for _, meth in self.methods:
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign) and \
+                        self._is_lock_factory(node.value):
+                    for tgt in node.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id in ("self", "cls")):
+                            self.lock_attrs.add(tgt.attr)
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, (ast.Store, ast.Del))
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in ("self", "cls")):
+                    self.instance_attrs.add(node.attr)
+
+    def _is_lock_factory(self, expr: ast.AST) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        head = _dotted_name(expr.func, self.aliases)
+        return head in _LOCK_FACTORIES
+
+    def lock_id(self, expr: ast.AST) -> Optional[str]:
+        """'self._lock' / 'cls._lock' / 'ClassName._lock' -> qualified id;
+        a bare module-level lock name resolves to '<module>.<name>'."""
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"<module>.{expr.id}"
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls") and \
+                        expr.attr in self.lock_attrs:
+                    return f"{self.name}.{expr.attr}"
+                if base.id in {c.name for c in self.classes} and \
+                        expr.attr in self.lock_attrs:
+                    return f"{self.name}.{expr.attr}"
+        return None
+
+    def owns_locks(self) -> bool:
+        return bool(self.lock_attrs)
+
+
+class LockDisciplinePass:
+    def run(self, relpath: str, tree: ast.Module,
+            source_lines: Sequence[str]) -> List[Finding]:
+        aliases = _import_aliases(tree)
+        findings: List[Finding] = []
+        order_pairs: List[Tuple[str, str, ast.AST, str]] = []
+        module_locks = _module_lock_names(tree, aliases)
+
+        classes = [n for n in tree.body if isinstance(n, ast.ClassDef)]
+        for family in self._families(classes, aliases, relpath,
+                                     module_locks):
+            if not family.owns_locks() and not module_locks:
+                continue
+            findings.extend(
+                self._analyze_family(family, order_pairs))
+        findings.extend(self._module_level(tree, aliases, relpath,
+                                           module_locks, order_pairs))
+        findings.extend(self._inversions(order_pairs, relpath))
+        return findings
+
+    # -- family construction ----------------------------------------------
+    def _families(self, classes: List[ast.ClassDef],
+                  aliases: Dict[str, str], relpath: str,
+                  module_locks: Set[str]) -> List[_ClassFamily]:
+        by_name = {c.name: c for c in classes}
+        parent: Dict[str, str] = {c.name: c.name for c in classes}
+
+        def find(x: str) -> str:
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        for c in classes:
+            for base in c.bases:
+                if isinstance(base, ast.Name) and base.id in by_name:
+                    parent[find(c.name)] = find(base.id)
+        groups: Dict[str, List[ast.ClassDef]] = {}
+        for c in classes:
+            groups.setdefault(find(c.name), []).append(c)
+        return [
+            _ClassFamily(root, members, aliases, relpath, module_locks)
+            for root, members in groups.items()
+        ]
+
+    # -- per-family analysis ----------------------------------------------
+    def _analyze_family(
+            self, family: _ClassFamily,
+            order_pairs: List[Tuple[str, str, ast.AST, str]]
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        scans: Dict[str, _MethodScan] = {}
+        for cls, meth in family.methods:
+            scan = _MethodScan(family, meth.name)
+            for stmt in meth.body:
+                scan.visit(stmt)
+            # later defs of the same name (subclass overrides) merge:
+            # both bodies belong to the family's behavior
+            key = f"{cls.name}.{meth.name}"
+            scans[key] = scan
+            order_pairs.extend(scan.order_pairs)
+            for name, node, held in scan.blocking:
+                findings.append(Finding(
+                    "GL203", family.relpath, node.lineno, node.col_offset,
+                    f"blocking call `{name}` while holding "
+                    f"{', '.join(held)} in {key}", symbol=key))
+            for node in scan.bare_acquires:
+                findings.append(Finding(
+                    "GL204", family.relpath, node.lineno, node.col_offset,
+                    f"bare .acquire() outside `with` in {key}",
+                    symbol=key))
+
+        # classes that never actually take any lock (but live in a module
+        # with a module-level lock) get no guard inference: GL205 on them
+        # would flag plain single-threaded state
+        uses_locks = bool(family.lock_attrs) or any(
+            scan.bare_acquires or scan.blocking or scan.order_pairs
+            or any(acc.held for acc in scan.accesses)
+            or any(cs.held for cs in scan.calls)
+            for scan in scans.values())
+        if not uses_locks:
+            return findings
+
+        entries = self._entry_locksets(family, scans)
+
+        # effective locksets per access
+        accesses: List[_Access] = []
+        for key, scan in scans.items():
+            meth_name = key.split(".", 1)[1]
+            if meth_name in _SKIP_METHODS:
+                continue
+            entry = entries.get(meth_name, frozenset())
+            for acc in scan.accesses:
+                held = set(acc.held)
+                if not acc.in_nested_def:
+                    held |= entry
+                accesses.append(dataclasses.replace(
+                    acc, held=tuple(sorted(held)),
+                    method=key))
+
+        findings.extend(self._infer_guards(family, accesses))
+        findings.extend(self._never_guarded(family, accesses))
+        return findings
+
+    def _entry_locksets(
+            self, family: _ClassFamily,
+            scans: Dict[str, _MethodScan]) -> Dict[str, frozenset]:
+        """Fixpoint: a private method's entry lockset is the intersection
+        of held locksets at its internal call sites."""
+        sites: Dict[str, List[_CallSite]] = {}
+        for scan in scans.values():
+            for cs in scan.calls:
+                sites.setdefault(cs.callee, []).append(cs)
+
+        memo: Dict[str, frozenset] = {}
+
+        def entry(meth: str, stack: Set[str]) -> frozenset:
+            if meth in memo:
+                return memo[meth]
+            if not meth.startswith("_") or meth.startswith("__"):
+                memo[meth] = frozenset()
+                return memo[meth]
+            call_sites = sites.get(meth)
+            if not call_sites:
+                memo[meth] = frozenset()
+                return memo[meth]
+            if meth in stack:
+                return frozenset()   # cycle: no caller contribution
+            acc: Optional[frozenset] = None
+            for cs in call_sites:
+                held = frozenset(cs.held) | entry(cs.caller,
+                                                  stack | {meth})
+                acc = held if acc is None else (acc & held)
+            memo[meth] = acc or frozenset()
+            return memo[meth]
+
+        return {m: entry(m, set())
+                for m in {s.split(".", 1)[1] for s in scans}}
+
+    def _infer_guards(self, family: _ClassFamily,
+                      accesses: List[_Access]) -> List[Finding]:
+        findings: List[Finding] = []
+        by_attr: Dict[str, List[_Access]] = {}
+        for acc in accesses:
+            by_attr.setdefault(acc.attr, []).append(acc)
+        for attr, accs in sorted(by_attr.items()):
+            if attr not in family.instance_attrs:
+                continue
+            total = len(accs)
+            counts: Dict[str, int] = {}
+            for acc in accs:
+                for lock in acc.held:
+                    counts[lock] = counts.get(lock, 0) + 1
+            if not counts:
+                continue
+            lock, guarded = max(counts.items(), key=lambda kv: kv[1])
+            if guarded < _MIN_GUARDED or guarded >= total or \
+                    guarded / total < _GUARDED_RATIO:
+                continue
+            for acc in accs:
+                if lock in acc.held:
+                    continue
+                kind = "write" if acc.is_write else "read"
+                findings.append(Finding(
+                    "GL201", family.relpath, acc.line, acc.col,
+                    f"unguarded {kind} of '{family.name}.{attr}' "
+                    f"(guarded by {lock} in {guarded}/{total} accesses) "
+                    f"in {acc.method}", symbol=acc.method))
+        return findings
+
+    def _never_guarded(self, family: _ClassFamily,
+                       accesses: List[_Access]) -> List[Finding]:
+        findings: List[Finding] = []
+        by_attr: Dict[str, List[_Access]] = {}
+        for acc in accesses:
+            by_attr.setdefault(acc.attr, []).append(acc)
+        for attr, accs in sorted(by_attr.items()):
+            if attr not in family.instance_attrs:
+                continue
+            if any(acc.held for acc in accs):
+                continue
+            writers = {acc.method for acc in accs if acc.is_write}
+            if len(writers) < 2:
+                continue
+            for acc in accs:
+                if not acc.is_write:
+                    continue
+                findings.append(Finding(
+                    "GL205", family.relpath, acc.line, acc.col,
+                    f"'{family.name}.{attr}' is written from "
+                    f"{len(writers)} methods of a lock-owning class but "
+                    f"never accessed under a lock", symbol=acc.method))
+        return findings
+
+    # -- module-level locks ------------------------------------------------
+    def _module_level(
+            self, tree: ast.Module, aliases: Dict[str, str], relpath: str,
+            lock_names: Set[str],
+            order_pairs: List[Tuple[str, str, ast.AST, str]]
+    ) -> List[Finding]:
+        """Module-level functions using module-level locks, analyzed with
+        the SAME _MethodScan walker the class pass uses (one copy of the
+        lock-stack / blocking-call / bare-acquire logic)."""
+        if not lock_names:
+            return []
+        owner = _ModuleOwner(aliases, lock_names)
+        findings: List[Finding] = []
+        for node in tree.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            scan = _MethodScan(owner, node.name)
+            for stmt in node.body:
+                scan.visit(stmt)
+            order_pairs.extend(scan.order_pairs)
+            for name, cnode, held in scan.blocking:
+                findings.append(Finding(
+                    "GL203", relpath, cnode.lineno, cnode.col_offset,
+                    f"blocking call `{name}` while holding "
+                    f"{', '.join(held)} in {node.name}",
+                    symbol=node.name))
+            for cnode in scan.bare_acquires:
+                findings.append(Finding(
+                    "GL204", relpath, cnode.lineno, cnode.col_offset,
+                    f"bare .acquire() outside `with` in {node.name}",
+                    symbol=node.name))
+        return findings
+
+    # -- GL202 --------------------------------------------------------------
+    def _inversions(
+            self, order_pairs: List[Tuple[str, str, ast.AST, str]],
+            relpath: str) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Dict[Tuple[str, str], Tuple[ast.AST, str]] = {}
+        reported: Set[frozenset] = set()
+        for a, b, node, method in order_pairs:
+            seen.setdefault((a, b), (node, method))
+        for (a, b), (node, method) in sorted(
+                seen.items(), key=lambda kv: kv[1][0].lineno):
+            if (b, a) in seen and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                other_node, other_method = seen[(b, a)]
+                # report at the LATER site (where the inversion appears),
+                # citing the established order
+                if other_node.lineno > node.lineno:
+                    node, other_node = other_node, node
+                    method, other_method = other_method, method
+                    a, b = b, a
+                findings.append(Finding(
+                    "GL202", relpath, node.lineno, node.col_offset,
+                    f"lock order inversion: {a} -> {b} here but "
+                    f"{b} -> {a} at line {other_node.lineno} "
+                    f"({other_method})", symbol=method))
+        return findings

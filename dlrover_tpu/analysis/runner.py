@@ -1,0 +1,198 @@
+"""graftlint driver: walk a package, run both passes, apply baseline.
+
+The baseline file (tools/graftlint_baseline.json) holds fingerprints of
+accepted pre-existing findings; the gate fails only on findings NOT in the
+baseline, so the analyzer can be adopted incrementally without a
+flag-day cleanup (and the tier-1 test stays green while still catching
+every *new* violation).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.analysis.findings import (
+    Finding,
+    apply_pragmas,
+    file_skipped,
+    sort_findings,
+    source_line,
+)
+from dlrover_tpu.analysis.lock_discipline import LockDisciplinePass
+from dlrover_tpu.analysis.trace_safety import TraceSafetyPass
+
+BASELINE_VERSION = 1
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[Finding]              # all post-pragma findings
+    new_findings: List[Finding]          # not covered by the baseline
+    fingerprints: Dict[str, str]         # fingerprint -> "path:line rule"
+    files_analyzed: int = 0
+    parse_errors: List[str] = dataclasses.field(default_factory=list)
+    analyzed_relpaths: List[str] = dataclasses.field(default_factory=list)
+
+
+def package_relpath(path: str) -> Optional[str]:
+    """Path relative to the TOP enclosing package directory (the nearest
+    ancestor chain of __init__.py dirs), or None outside any package.
+
+    Anchoring on the package — not the invocation root — keeps hot-path
+    prefixes (``trainer/``) and baseline fingerprints identical whether
+    the analyzer is pointed at ``dlrover_tpu``, ``dlrover_tpu/trainer``,
+    or a single file."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+    top = None
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        top = d
+        d = os.path.dirname(d)
+    if top is None:
+        return None
+    return os.path.relpath(path, top).replace(os.sep, "/")
+
+
+def iter_python_files(root: str) -> Iterable[Tuple[str, str]]:
+    """Yield (abspath, relpath) for package .py files, skipping caches."""
+    root = os.path.abspath(root)
+    if os.path.isfile(root):
+        yield root, package_relpath(root) or os.path.basename(root)
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d not in ("__pycache__", ".git", "node_modules"))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                path = os.path.join(dirpath, name)
+                yield path, package_relpath(path) or os.path.relpath(
+                    path, root).replace(os.sep, "/")
+
+
+def analyze_file(path: str, relpath: str,
+                 source: Optional[str] = None) -> List[Finding]:
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    lines = source.splitlines()
+    if file_skipped(lines):
+        return []
+    tree = ast.parse(source, filename=path)
+    findings: List[Finding] = []
+    findings.extend(TraceSafetyPass().run(relpath, tree, lines))
+    findings.extend(LockDisciplinePass().run(relpath, tree, lines))
+    return apply_pragmas(findings, lines)
+
+
+def run_analysis(roots: Sequence[str],
+                 baseline: Optional[Dict] = None) -> AnalysisResult:
+    pairs: List[Tuple[Finding, str]] = []   # (finding, fingerprint)
+    fingerprints: Dict[str, str] = {}
+    parse_errors: List[str] = []
+    analyzed: List[str] = []
+    seen_paths: set = set()
+    files = 0
+    for root in roots:
+        for path, relpath in iter_python_files(root):
+            abspath = os.path.abspath(path)
+            if abspath in seen_paths:
+                continue      # overlapping roots: analyze each file once
+            seen_paths.add(abspath)
+            files += 1
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                found = analyze_file(path, relpath, source)
+            except (SyntaxError, ValueError, UnicodeDecodeError,
+                    OSError) as e:
+                # SyntaxError from ast.parse; ValueError for NUL bytes;
+                # UnicodeDecodeError for non-UTF8 sources; OSError for
+                # unreadable files (dangling symlink, permissions). NOT
+                # recorded as analyzed: a file that failed to parse must
+                # keep its baseline entries (write_baseline drops entries
+                # only for successfully re-analyzed files)
+                parse_errors.append(f"{relpath}: {e}")
+                continue
+            analyzed.append(relpath)
+            lines = source.splitlines()
+            # identical findings on textually identical lines (same rule,
+            # symbol, source text) get an occurrence suffix in line order:
+            # baselining the first must NOT suppress a second, newly-added
+            # copy of the same violation
+            found.sort(key=lambda f: (f.line, f.col, f.rule_id))
+            occurrence: Dict[str, int] = {}
+            for fnd in found:
+                base = fnd.fingerprint(source_line(lines, fnd.line))
+                n = occurrence.get(base, 0)
+                occurrence[base] = n + 1
+                fp = base if n == 0 else f"{base}#{n}"
+                fingerprints[fp] = f"{fnd.path}:{fnd.line} {fnd.rule_id}"
+                pairs.append((fnd, fp))
+    suppressed = set((baseline or {}).get("suppressions", []))
+    new = [fnd for fnd, fp in pairs if fp not in suppressed]
+    return AnalysisResult(
+        findings=sort_findings([f for f, _ in pairs]),
+        new_findings=sort_findings(new),
+        fingerprints=fingerprints,
+        files_analyzed=files,
+        parse_errors=parse_errors,
+        analyzed_relpaths=analyzed,
+    )
+
+
+def load_baseline(path: str) -> Optional[Dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')}")
+    return data
+
+
+def write_baseline(path: str, result: AnalysisResult) -> None:
+    """Accept the run's findings into the baseline.
+
+    Entries for files ANALYZED in this run are replaced by the run's
+    findings (so fixed findings drop out); entries for files outside the
+    analyzed roots are preserved — a partial-tree `--write-baseline`
+    must not discard the rest of the package's accepted debt."""
+    notes: Dict[str, str] = dict(result.fingerprints)
+    analyzed = set(result.analyzed_relpaths)
+    old = None
+    if os.path.exists(path):
+        try:
+            with open(path, encoding="utf-8") as f:
+                old = json.load(f)
+        except (ValueError, OSError) as e:
+            # refuse rather than silently discard every previously
+            # accepted suppression outside the analyzed roots
+            raise ValueError(
+                f"existing baseline {path} is unreadable ({e}); fix or "
+                f"delete it before --write-baseline") from e
+    for fp in (old or {}).get("suppressions", []):
+        if fp in notes:
+            continue
+        note = (old or {}).get("notes", {}).get(fp, "")
+        note_path = note.split(":", 1)[0]   # note format: "path:line RULE"
+        if note_path and note_path in analyzed:
+            continue      # re-derived (or fixed) in this run: drop
+        notes[fp] = note
+    data = {
+        "version": BASELINE_VERSION,
+        "comment": (
+            "accepted pre-existing graftlint findings; regenerate with "
+            "`python tools/graftlint.py --write-baseline <roots>` after "
+            "reviewing that every entry is a deliberate acceptance"),
+        "suppressions": sorted(notes),
+        "notes": {fp: where for fp, where in sorted(notes.items())},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")

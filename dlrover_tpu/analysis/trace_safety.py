@@ -1,0 +1,643 @@
+"""Pass 1: trace-safety analysis of jitted/shard_mapped functions.
+
+Resolves the set of *traced* functions per module — functions passed to
+``jax.jit`` / ``pjit`` / ``shard_map`` / ``jax.eval_shape`` (as decorators
+or call arguments, through ``functools.partial`` and ``self.method``
+references), plus everything they call or hand to ``lax.scan``-style
+combinators within the module — then checks four invariants elastic
+re-lowering depends on:
+
+GL101  Python ``if``/``while`` on a traced argument (taint-propagated;
+       static shape/dtype/``is None`` tests are exempt — those resolve at
+       trace time).
+GL102  impure calls (``time.*``, ``np.random.*``, ``random.*``,
+       ``os.environ``, ``print``/``open``/``input``) inside traced code.
+GL103  mutation of enclosing state (``global``/``nonlocal``, ``self.x =``,
+       container mutation of closure/module names) inside traced code.
+GL104  a ``jax.jit`` whose target threads state-like parameters but the
+       call carries no ``donate_argnums``/``donate_argnames``.
+GL105  ``device_get``/``block_until_ready``/``.item()`` lexically inside a
+       loop in hot-path modules (``trainer/``) — a per-step host sync.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
+
+from dlrover_tpu.analysis.findings import Finding
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+# call heads (alias-normalized dotted names) that trace their first
+# positional argument
+_JIT_HEADS = {"jax.jit", "jit", "jax.pjit", "pjit",
+              "jax.experimental.pjit.pjit"}
+_TRACING_HEADS = _JIT_HEADS | {
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.eval_shape",
+}
+# combinators whose function-valued arguments are traced when reached
+# from traced code
+_COMBINATOR_HEADS = {
+    "jax.lax.scan", "jax.lax.fori_loop", "jax.lax.while_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.associative_scan",
+    "jax.vmap", "jax.grad", "jax.value_and_grad", "jax.vjp", "jax.jvp",
+    "jax.checkpoint", "jax.remat", "jax.custom_vjp", "jax.custom_jvp",
+}
+
+_IMPURE_EXACT = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.sleep", "time.monotonic_ns", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "os.getenv", "os.urandom", "uuid.uuid4",
+}
+_IMPURE_PREFIX = ("numpy.random.", "random.", "os.environ")
+_IMPURE_BUILTINS = {"print", "open", "input"}
+_PURE_EXEMPT = {"jax.debug.print", "jax.debug.callback"}
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+_STATIC_FUNCS = {"len", "isinstance", "hasattr", "getattr", "type",
+                 "callable", "typeof"}
+_STATE_PARAM_EXACT = {"state", "train_state", "carry", "opt_state"}
+_STATE_PARAM_SUFFIX = ("_state", "_opt")
+_MUTATING_METHODS = {"append", "extend", "add", "update", "setdefault",
+                     "insert", "remove", "clear", "pop", "popitem",
+                     "discard", "appendleft", "extendleft"}
+_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_SYNC_METHODS = {"block_until_ready", "item"}
+
+
+def _build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """local name -> dotted real name, from module-level-ish imports."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _dotted_name(node: ast.AST,
+                 aliases: Dict[str, str]) -> Optional[str]:
+    """'np.random.normal' -> 'numpy.random.normal' (root alias-resolved)."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    root = aliases.get(cur.id, cur.id)
+    parts.append(root)
+    return ".".join(reversed(parts))
+
+
+class _Scopes:
+    """Name -> FunctionDef resolution through lexical scopes, plus
+    class-method resolution for `self.f` references."""
+
+    def __init__(self, tree: ast.Module,
+                 parents: Dict[ast.AST, ast.AST]):
+        self._parents = parents
+        self._defs: Dict[int, Dict[str, FunctionNode]] = {}
+        self._methods: Dict[ast.ClassDef, Dict[str, ast.FunctionDef]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = self._enclosing_scope(node)
+                self._defs.setdefault(id(scope), {})[node.name] = node
+                if isinstance(scope, ast.ClassDef):
+                    self._methods.setdefault(scope, {})[node.name] = node
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Lambda):
+                scope = self._enclosing_scope(node)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._defs.setdefault(
+                            id(scope), {})[tgt.id] = node.value
+
+    def _enclosing_scope(self, node: ast.AST) -> ast.AST:
+        cur = self._parents.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef,
+                      ast.ClassDef)):
+            cur = self._parents.get(cur)
+        return cur
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = self._parents.get(cur)
+            if isinstance(cur, ast.ClassDef):
+                return cur
+        return None
+
+    def resolve(self, expr: ast.AST,
+                from_node: ast.AST) -> Optional[FunctionNode]:
+        """Resolve a function-valued expression to its def, or None."""
+        fn, _ = self.resolve_with_bound(expr, from_node)
+        return fn
+
+    def resolve_with_bound(
+            self, expr: ast.AST, from_node: ast.AST
+    ) -> Tuple[Optional[FunctionNode], Set[str]]:
+        """Like resolve, additionally returning parameter names bound by
+        ``functools.partial`` — those are Python constants at trace time
+        (static), not tracers."""
+        if isinstance(expr, ast.Call):
+            head = _dotted_name(expr.func, {})
+            if head and head.split(".")[-1] == "partial" and expr.args:
+                fn, inner_bound = self.resolve_with_bound(
+                    expr.args[0], from_node)
+                if fn is None:
+                    return None, set()
+                bound = set(inner_bound)
+                params = _fn_params(fn)
+                bound.update(params[:len(expr.args) - 1])
+                bound.update(kw.arg for kw in expr.keywords if kw.arg)
+                return fn, bound
+            return None, set()
+        return self._resolve_plain(expr, from_node), set()
+
+    def _resolve_plain(self, expr: ast.AST,
+                       from_node: ast.AST) -> Optional[FunctionNode]:
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id in ("self", "cls"):
+            cls = self.enclosing_class(from_node)
+            if cls is not None:
+                return self._methods.get(cls, {}).get(expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            scope: Optional[ast.AST] = self._enclosing_scope(from_node)
+            while scope is not None:
+                found = self._defs.get(id(scope), {}).get(expr.id)
+                if found is not None:
+                    return found
+                if isinstance(scope, ast.Module):
+                    break
+                scope = self._enclosing_scope(scope)
+            return None
+        return None
+
+
+def _jit_kwargs(call: ast.Call) -> Dict[str, ast.expr]:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _static_param_names(call: Optional[ast.Call],
+                        fn: FunctionNode) -> Set[str]:
+    """Names of params marked static via static_argnums/static_argnames."""
+    if call is None:
+        return set()
+    kwargs = _jit_kwargs(call)
+    names: Set[str] = set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    num_expr = kwargs.get("static_argnums")
+    if num_expr is not None:
+        for n in ast.walk(num_expr):
+            if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                if 0 <= n.value < len(params):
+                    names.add(params[n.value])
+    name_expr = kwargs.get("static_argnames")
+    if name_expr is not None:
+        for n in ast.walk(name_expr):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                names.add(n.value)
+    return names
+
+
+def _fn_params(fn: FunctionNode) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _qualname(fn: FunctionNode, scopes: _Scopes) -> str:
+    if isinstance(fn, ast.Lambda):
+        return "<lambda>"
+    cls = scopes.enclosing_class(fn)
+    return f"{cls.name}.{fn.name}" if cls else fn.name
+
+
+class TraceSafetyPass:
+    """Analyze one parsed module; returns findings."""
+
+    def __init__(self, hot_path_prefixes: Sequence[str] = ("trainer/",)):
+        self._hot_prefixes = tuple(hot_path_prefixes)
+
+    def run(self, relpath: str, tree: ast.Module,
+            source_lines: Sequence[str]) -> List[Finding]:
+        self.relpath = relpath
+        self.aliases = _import_aliases(tree)
+        self.parents = _build_parents(tree)
+        self.scopes = _Scopes(tree, self.parents)
+        findings: List[Finding] = []
+        traced = self._collect_traced(tree, findings)
+        for fn, tainted_params in traced.items():
+            findings.extend(self._check_traced_fn(fn, tainted_params))
+        findings.extend(self._check_hot_loop_sync(tree))
+        return findings
+
+    # -- traced-set resolution --------------------------------------------
+    def _collect_traced(
+            self, tree: ast.Module, findings: List[Finding]
+    ) -> Dict[FunctionNode, Set[str]]:
+        """Map traced function -> set of TAINTED (tracer-valued) params.
+
+        Roots get all params minus static_argnums/static_argnames and
+        partial-bound names. Transitive callees get taint mapped through
+        call-site arguments: a param receiving a static closure value
+        stays untainted (fit_block(x, block=128) branches on Python ints,
+        not tracers). Functions passed as *values* to combinators
+        (lax.scan bodies) conservatively taint every param.
+        """
+        roots: List[Tuple[FunctionNode, Set[str]]] = []
+
+        def add_root(fn: FunctionNode, bound: Set[str],
+                     call: Optional[ast.Call]) -> None:
+            static = bound | _static_param_names(call, fn)
+            roots.append((fn, set(_fn_params(fn)) - static))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                head = _dotted_name(node.func, self.aliases)
+                if head in _TRACING_HEADS and node.args:
+                    fn, bound = self.scopes.resolve_with_bound(
+                        node.args[0], node)
+                    if fn is not None:
+                        add_root(fn, bound, node)
+                        if head in _JIT_HEADS:
+                            self._check_donation(node, fn, findings)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    head = _dotted_name(deco, self.aliases)
+                    if head in _TRACING_HEADS:
+                        add_root(node, set(), None)
+                        if head in _JIT_HEADS:
+                            self._check_donation(None, node, findings,
+                                                 deco_line=deco.lineno)
+                    elif isinstance(deco, ast.Call):
+                        inner = _dotted_name(deco.func, self.aliases)
+                        inner_last = (inner or "").split(".")[-1]
+                        if inner in _TRACING_HEADS:
+                            add_root(node, set(), deco)
+                            if inner in _JIT_HEADS:
+                                self._check_donation(deco, node, findings)
+                        elif inner_last == "partial" and deco.args:
+                            part_head = _dotted_name(deco.args[0],
+                                                     self.aliases)
+                            if part_head in _TRACING_HEADS:
+                                add_root(node, set(), deco)
+                                if part_head in _JIT_HEADS:
+                                    self._check_donation(deco, node,
+                                                         findings)
+
+        traced: Dict[FunctionNode, Set[str]] = {}
+        work = list(roots)
+        while work:
+            fn, tainted_params = work.pop()
+            known = traced.get(fn)
+            if known is not None and tainted_params <= known:
+                continue
+            traced[fn] = (known or set()) | tainted_params
+            tainted = set(traced[fn])
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            self._propagate_taint(body, tainted)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee, bound = self.scopes.resolve_with_bound(
+                    node.func, node)
+                if callee is not None and callee is not fn:
+                    work.append(
+                        (callee,
+                         self._map_call_taint(node, callee, tainted)
+                         - bound))
+                head = _dotted_name(node.func, self.aliases)
+                if head in _COMBINATOR_HEADS or head in _TRACING_HEADS:
+                    for arg in node.args:
+                        sub, sub_bound = self.scopes.resolve_with_bound(
+                            arg, node)
+                        if sub is not None and sub is not fn:
+                            work.append(
+                                (sub,
+                                 set(_fn_params(sub)) - sub_bound))
+        return traced
+
+    def _map_call_taint(self, call: ast.Call, callee: FunctionNode,
+                        caller_tainted: Set[str]) -> Set[str]:
+        """Which callee params receive tainted values at this call."""
+        params = _fn_params(callee)
+        out: Set[str] = set()
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                # can't track positions past a splat: taint the rest
+                out.update(params[i:])
+                break
+            if i < len(params) and self._expr_taints(arg, caller_tainted):
+                out.add(params[i])
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue          # **kwargs splat: unknown names, skip
+            if kw.arg in params and self._expr_taints(kw.value,
+                                                      caller_tainted):
+                out.add(kw.arg)
+        return out
+
+    # -- GL104 -------------------------------------------------------------
+    def _check_donation(self, call: Optional[ast.Call], fn: FunctionNode,
+                        findings: List[Finding],
+                        deco_line: Optional[int] = None) -> None:
+        kwargs = _jit_kwargs(call) if call is not None else {}
+        if "donate_argnums" in kwargs or "donate_argnames" in kwargs:
+            return
+        stateful = [
+            p for p in _fn_params(fn)
+            if p in _STATE_PARAM_EXACT or p.endswith(_STATE_PARAM_SUFFIX)
+        ]
+        if not stateful:
+            return
+        if not self._threads_state(fn, set(stateful)):
+            # read-only use (eval/metrics): the state is NOT returned
+            # updated, so donating it would invalidate the caller's copy
+            return
+        node = call if call is not None else fn
+        line = deco_line if deco_line is not None else node.lineno
+        findings.append(Finding(
+            "GL104", self.relpath, line,
+            getattr(node, "col_offset", 0),
+            f"jit of '{_qualname(fn, self.scopes)}' threads state-like "
+            f"parameters ({', '.join(stateful)}) but passes no "
+            f"donate_argnums/donate_argnames",
+            symbol=_qualname(fn, self.scopes)))
+
+    def _threads_state(self, fn: FunctionNode,
+                       state_params: Set[str]) -> bool:
+        """True when the function RETURNS updated state: some top-level
+        return value (or tuple element) is a bare name tainted by a
+        state-like param. `return loss.sum()` (read-only eval) is not
+        threading; `return new_state, metrics` is."""
+        if isinstance(fn, ast.Lambda):
+            body_stmts: List[ast.stmt] = []
+            returns: List[ast.expr] = [fn.body]
+        else:
+            body_stmts = fn.body
+            returns = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    owner = self._enclosing_function(node)
+                    if owner is fn:
+                        returns.append(node.value)
+        tainted = set(state_params)
+        self._propagate_taint(body_stmts, tainted)
+        for value in returns:
+            elements = (value.elts if isinstance(value, ast.Tuple)
+                        else [value])
+            for el in elements:
+                if isinstance(el, ast.Name) and el.id in tainted:
+                    return True
+        return False
+
+    # -- per-function checks (GL101/102/103) -------------------------------
+    def _check_traced_fn(self, fn: FunctionNode,
+                         tainted_params: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        qual = _qualname(fn, self.scopes)
+        params = set(tainted_params)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+
+        # locals: every name stored anywhere in the function
+        local_names: Set[str] = set(_fn_params(fn)) | {"self", "cls", "_"}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                local_names.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_names.add(node.name)
+
+        tainted = set(params)
+        self._propagate_taint(body, tainted)
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                if self._expr_taints(node.test, tainted):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    names = sorted(self._tainted_names(node.test, tainted))
+                    findings.append(Finding(
+                        "GL101", self.relpath, node.lineno,
+                        node.col_offset,
+                        f"Python `{kind}` on traced value(s) "
+                        f"{', '.join(names)} inside traced "
+                        f"'{qual}'", symbol=qual))
+            elif isinstance(node, ast.Call):
+                f = self._impure_call(node)
+                if f:
+                    findings.append(Finding(
+                        "GL102", self.relpath, node.lineno,
+                        node.col_offset,
+                        f"impure call `{f}` inside traced '{qual}'",
+                        symbol=qual))
+            elif (isinstance(node, ast.Subscript)
+                  and _dotted_name(node.value,
+                                   self.aliases) == "os.environ"):
+                findings.append(Finding(
+                    "GL102", self.relpath, node.lineno, node.col_offset,
+                    f"`os.environ[...]` read inside traced '{qual}'",
+                    symbol=qual))
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                findings.append(Finding(
+                    "GL103", self.relpath, node.lineno, node.col_offset,
+                    f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                    f" {', '.join(node.names)}` inside traced '{qual}'",
+                    symbol=qual))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    root = self._root_name(tgt)
+                    if root == "self" and not isinstance(tgt, ast.Name):
+                        findings.append(Finding(
+                            "GL103", self.relpath, node.lineno,
+                            node.col_offset,
+                            f"write to `self` attribute inside traced "
+                            f"'{qual}'", symbol=qual))
+                    elif (isinstance(tgt, (ast.Subscript, ast.Attribute))
+                          and root is not None
+                          and root not in local_names):
+                        findings.append(Finding(
+                            "GL103", self.relpath, node.lineno,
+                            node.col_offset,
+                            f"mutation of enclosing-scope `{root}` inside "
+                            f"traced '{qual}'", symbol=qual))
+        # container-mutation method calls on closure/module names — only
+        # when the result is discarded (a bare `x.append(v)` statement);
+        # `new, opt = tx.update(...)` is the pure-functional optax idiom
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATING_METHODS
+                    and isinstance(self.parents.get(node), ast.Expr)):
+                root = self._root_name(node.func.value)
+                if root is not None and root not in local_names:
+                    findings.append(Finding(
+                        "GL103", self.relpath, node.lineno,
+                        node.col_offset,
+                        f"`{root}.{node.func.attr}(...)` mutates "
+                        f"enclosing scope inside traced '{qual}'",
+                        symbol=qual))
+        return findings
+
+    def _propagate_taint(self, body: List[ast.stmt],
+                         tainted: Set[str]) -> None:
+        """Forward sweeps to fixpoint adding assignment targets whose RHS
+        uses a tainted value non-statically. Terminates: the tainted set
+        only grows and is bounded by the function's name count."""
+        while True:
+            before = len(tainted)
+            for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+                if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                    value = node.value
+                    if value is None or not self._expr_taints(value,
+                                                              tainted):
+                        continue
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for tgt in targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+                elif isinstance(node, ast.For):
+                    if self._expr_taints(node.iter, tainted):
+                        for n in ast.walk(node.target):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            if len(tainted) == before:
+                break
+
+    def _tainted_names(self, expr: ast.AST,
+                       tainted: Set[str]) -> Set[str]:
+        out: Set[str] = set()
+        parents = _build_parents(expr)
+
+        def is_static_usage(name_node: ast.Name) -> bool:
+            cur: ast.AST = name_node
+            parent = parents.get(cur)
+            while parent is not None:
+                if isinstance(parent, ast.Attribute) and \
+                        parent.attr in _STATIC_ATTRS:
+                    return True
+                if isinstance(parent, ast.Call):
+                    head = _dotted_name(parent.func, self.aliases)
+                    if head in _STATIC_FUNCS or (
+                            head and head.split(".")[-1] in _STATIC_FUNCS):
+                        return True
+                if isinstance(parent, ast.Compare) and all(
+                        isinstance(op, (ast.Is, ast.IsNot))
+                        for op in parent.ops):
+                    return True
+                cur, parent = parent, parents.get(parent)
+            return False
+
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in tainted:
+                if not is_static_usage(node):
+                    out.add(node.id)
+        return out
+
+    def _expr_taints(self, expr: ast.AST, tainted: Set[str]) -> bool:
+        return bool(self._tainted_names(expr, tainted))
+
+    def _root_name(self, node: ast.AST) -> Optional[str]:
+        cur = node
+        while isinstance(cur, (ast.Attribute, ast.Subscript)):
+            cur = cur.value
+        return cur.id if isinstance(cur, ast.Name) else None
+
+    def _impure_call(self, call: ast.Call) -> Optional[str]:
+        head = _dotted_name(call.func, self.aliases)
+        if head is None:
+            return None
+        if head in _PURE_EXEMPT:
+            return None
+        if head in _IMPURE_BUILTINS or head in _IMPURE_EXACT:
+            return head
+        for prefix in _IMPURE_PREFIX:
+            if head == prefix.rstrip(".") or head.startswith(prefix):
+                # `random.` must be the stdlib module, not a local var —
+                # _dotted_name only alias-resolves the ROOT name, so check
+                # the root really is an import
+                root = head.split(".")[0]
+                if root in self.aliases.values() or root in (
+                        "os", "random", "numpy", "time", "datetime"):
+                    if root == "random" and "random" not in self.aliases:
+                        return None
+                    return head
+        return None
+
+    # -- GL105 -------------------------------------------------------------
+    def _check_hot_loop_sync(self, tree: ast.Module) -> List[Finding]:
+        if not self.relpath.startswith(self._hot_prefixes):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            head = _dotted_name(node.func, self.aliases)
+            is_sync = head in _SYNC_CALLS or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS)
+            if not is_sync:
+                continue
+            loop = self._enclosing_loop(node)
+            if loop is None:
+                continue
+            fn = self._enclosing_function(node)
+            qual = _qualname(fn, self.scopes) if fn is not None else ""
+            what = head or node.func.attr  # type: ignore[union-attr]
+            findings.append(Finding(
+                "GL105", self.relpath, node.lineno, node.col_offset,
+                f"blocking host sync `{what}` inside a loop in hot-path "
+                f"module (per-iteration device stall)", symbol=qual))
+        return findings
+
+    def _enclosing_loop(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.While)):
+                return cur
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                # don't escape into an enclosing function's loop: a helper
+                # defined inside a loop body runs when called, not per
+                # iteration of the def site
+                return None
+            cur = self.parents.get(cur)
+        return None
+
+    def _enclosing_function(self, node: ast.AST) -> Optional[FunctionNode]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None

@@ -13,7 +13,7 @@ budget, with deterministic tie-breaking toward smaller strategies.
 from __future__ import annotations
 
 import os
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from dlrover_tpu.auto.engine.dry_runner import dry_run
 from dlrover_tpu.auto.engine.planner import plan_candidates

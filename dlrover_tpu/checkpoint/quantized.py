@@ -31,7 +31,7 @@ restore sides always agree on the tree structure.
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -71,7 +71,7 @@ class Context:
         with cls._lock:
             if cls._instance is None:
                 cls._instance = cls()
-        return cls._instance
+            return cls._instance
 
     @classmethod
     def reset(cls) -> None:

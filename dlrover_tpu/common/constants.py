@@ -79,7 +79,8 @@ class RendezvousName:
 
 
 class NodeEnv:
-    """Env-var contract (reference: constants.py NodeEnv / NodeEnv.DLROVER_MASTER_ADDR)."""
+    """Env-var contract (reference: constants.py NodeEnv /
+    NodeEnv.DLROVER_MASTER_ADDR)."""
 
     MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
     NODE_ID = "DLROVER_TPU_NODE_ID"

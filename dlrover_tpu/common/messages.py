@@ -13,7 +13,7 @@ from __future__ import annotations
 import io
 import pickle
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 
 class Message:
@@ -217,7 +217,8 @@ class CommWorld(Message):
     rdzv_name: str = ""
     round: int = 0
     group: int = 0
-    world: Dict[int, int] = field(default_factory=dict)  # node_rank → local_world_size
+    # node_rank → local_world_size
+    world: Dict[int, int] = field(default_factory=dict)
 
 
 @dataclass

@@ -12,11 +12,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from dlrover_tpu.common.constants import (
-    NodeExitReason,
-    NodeStatus,
-    NodeType,
-)
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
 
 
 @dataclass

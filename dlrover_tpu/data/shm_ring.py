@@ -10,14 +10,12 @@ ring (multiprocessing.shared_memory) when the native toolchain is absent.
 from __future__ import annotations
 
 import ctypes
-import os
 import pickle
 import struct
 import time
 import uuid
 from typing import Any, Iterator, List, Optional
 
-from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.native_build import load_native
 
 

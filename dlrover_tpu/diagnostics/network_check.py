@@ -24,7 +24,7 @@ import subprocess
 import sys
 import tempfile
 import time
-from typing import Dict, Tuple
+from typing import Tuple
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.bootstrap import publish_or_wait_coordinator

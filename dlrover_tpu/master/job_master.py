@@ -10,8 +10,6 @@ hang; the node manager (when attached) owns node lifecycle.
 from __future__ import annotations
 
 import threading
-import time
-from typing import Dict, Optional
 
 from dlrover_tpu.common.comm import build_server
 from dlrover_tpu.common.config import Context

@@ -97,7 +97,10 @@ class JobManager:
         return plan
 
     def start(self) -> None:
-        self._stage = JobStage.RUNNING
+        # _stage is read by servicer threads and written by the monitor
+        # thread: every access holds the lock
+        with self._lock:
+            self._stage = JobStage.RUNNING
         self._init_nodes()
         self._watcher.prime()
         self._scaler.start()
@@ -170,7 +173,7 @@ class JobManager:
     # -- relaunch decision tree ----------------------------------------
     def _should_relaunch(self, node: Node) -> bool:
         """Reference: dist_job_manager.py:487-544."""
-        if self._stage != JobStage.RUNNING:
+        if self.job_stage() != JobStage.RUNNING:
             return False
         if not node.relaunchable:
             return False
@@ -236,25 +239,30 @@ class JobManager:
                 return
         if workers and all(n.status == NodeStatus.SUCCEEDED
                            for n in workers):
-            self._stage = JobStage.SUCCEEDED
+            with self._lock:
+                self._stage = JobStage.SUCCEEDED
             return
         failed = [n for n in workers
                   if n.status in (NodeStatus.FAILED, NodeStatus.BREAKDOWN)
                   and n.is_unrecoverable_failure()]
-        if workers and len(failed) == len(workers) and workers:
+        if workers and len(failed) == len(workers):
             self._fail_job("all workers failed unrecoverably")
 
     def _fail_job(self, reason: str) -> None:
-        if self._stage != JobStage.FAILED:
-            logger.error("job failed: %s", reason)
+        with self._lock:
+            if self._stage == JobStage.FAILED:
+                return
             self._stage = JobStage.FAILED
             self._exit_reason = reason
+        logger.error("job failed: %s", reason)
 
     def job_stage(self) -> str:
-        return self._stage
+        with self._lock:
+            return self._stage
 
     def exit_reason(self) -> str:
-        return self._exit_reason
+        with self._lock:
+            return self._exit_reason
 
     # -- servicer-facing API -------------------------------------------
     def update_node_resource_usage(self, stats: msg.NodeResourceStats

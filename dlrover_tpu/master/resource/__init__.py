@@ -1,4 +1,5 @@
-"""Resource plans, optimizers, auto-scaling (reference: dlrover/python/master/resource/)."""
+"""Resource plans, optimizers, auto-scaling
+(reference: dlrover/python/master/resource/)."""
 
 from dlrover_tpu.master.resource.optimizer import (
     ResourceLimits,

@@ -16,7 +16,7 @@ XLA path (`attn_impl="reference"`), selected per config.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax

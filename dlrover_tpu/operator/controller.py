@@ -9,8 +9,7 @@ the in-memory LocalCluster (tests/standalone) or the k8s REST client.
 from __future__ import annotations
 
 import threading
-import time
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
 
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.constants import NodeStatus, NodeType

@@ -12,7 +12,7 @@ manifest (de)serialization. The YAML CRD definitions live in `manifests/`.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from dlrover_tpu.common.node import NodeResource
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Optional
 
-from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.operator.controller import ElasticJobController
 from dlrover_tpu.operator.crd import (

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import ctypes
 import zlib
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import numpy as np

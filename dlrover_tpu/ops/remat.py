@@ -1,4 +1,6 @@
-"""Named rematerialization policies (consumed by model configs and\nthe checkpoint/remat optimization; reference analog: atorch\nactivation_checkpointing.py policy selection)."""
+"""Named rematerialization policies (consumed by model configs and
+the checkpoint/remat optimization; reference analog: atorch
+activation_checkpointing.py policy selection)."""
 
 
 def resolve_remat_policy(name: str):

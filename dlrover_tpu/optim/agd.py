@@ -10,7 +10,7 @@ by sqrt(b)) and SGD-like (divide by delta) behavior.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import chex
 import jax

@@ -17,13 +17,11 @@ function needed (its transpose falls out of autodiff).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-
-from dlrover_tpu.common.constants import MeshAxis
 
 
 @dataclasses.dataclass(frozen=True)

@@ -29,8 +29,7 @@ Autodiff through scan+ppermute yields the backward pipeline;
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp

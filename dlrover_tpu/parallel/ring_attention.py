@@ -32,7 +32,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from dlrover_tpu.common.constants import MeshAxis
@@ -48,7 +48,10 @@ def _use_flash_blocks(block_impl: str) -> bool:
     import os
 
     if block_impl == "auto":
-        block_impl = os.environ.get("DLROVER_TPU_SP_BLOCK_IMPL", "auto")
+        # deliberate trace-time read: kernel dispatch is a per-lowering
+        # decision and must re-resolve on every elastic re-trace
+        env = "DLROVER_TPU_SP_BLOCK_IMPL"
+        block_impl = os.environ.get(env, "auto")  # graftlint: disable=GL102
     block_impl = block_impl.strip().lower()
     if block_impl not in ("auto", "flash", "einsum"):
         raise ValueError(

@@ -14,13 +14,14 @@ ElasticPsService + SyncService machinery unchanged.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from dlrover_tpu.common.constants import MeshAxis
 
@@ -189,7 +190,9 @@ class ElasticEmbeddingTrainer:
         loss_fn = self.loss_fn
         embed_tx, dense_tx = self.embed_tx, self.dense_tx
 
-        @jax.jit
+        # donate the threaded state: the table + moments dominate HBM in
+        # the PS-analog path, and callers always rebind the returned tuple
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def step(embed_params, embed_opt, dense_params, dense_opt, ids,
                  labels):
             def compute(embed_p, dense_p):

@@ -9,7 +9,7 @@ size changed between save and restore).
 from __future__ import annotations
 
 import random
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List
 
 
 class ElasticDistributedSampler:

@@ -40,7 +40,6 @@ from typing import Any, Callable, Optional, Tuple
 import flax.struct
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from dlrover_tpu.models.llama import (

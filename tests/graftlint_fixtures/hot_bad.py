@@ -1,0 +1,11 @@
+# graftlint fixture: per-iteration host syncs in a hot-path module
+# (analyzed under the relpath "trainer/hot_bad.py"). Never executed.
+import jax
+
+
+def training_loop(step_fn, state, batches):
+    for batch in batches:
+        state, metrics = step_fn(state, batch)
+        loss = jax.device_get(metrics)            # BAD: GL105
+        metrics["loss"].block_until_ready()       # BAD: GL105
+    return state, loss

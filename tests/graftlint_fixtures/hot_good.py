@@ -1,0 +1,10 @@
+# graftlint fixture: host sync OUTSIDE the step loop — silent under the
+# relpath "trainer/hot_good.py". Never executed.
+import jax
+
+
+def training_loop(step_fn, state, batches):
+    metrics = None
+    for batch in batches:
+        state, metrics = step_fn(state, batch)
+    return state, jax.device_get(metrics)         # after the loop: fine

@@ -1,0 +1,68 @@
+# graftlint fixture: deliberate lock-discipline violations. Never
+# imported/executed; `# BAD: <rule>` markers are asserted exactly.
+import threading
+import time
+
+
+class BadStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+
+    def get(self, key):
+        with self._lock:
+            return self._items.get(key)
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def slow_put(self, key, value):
+        with self._lock:
+            time.sleep(0.1)                       # BAD: GL203
+            self._items[key] = value
+
+    def peek_unlocked(self, key):
+        return self._items.get(key)               # BAD: GL201
+
+    def manual(self):
+        self._lock.acquire()                      # BAD: GL204
+        self._lock.release()
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._x = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self._x = 1
+
+    def backward(self):
+        with self._b:
+            with self._a:                         # BAD: GL202
+                self._x = 2
+
+
+class UnguardedFlags:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data = []
+        self._status = "new"
+
+    def add(self, item):
+        with self._lock:
+            self._data.append(item)
+
+    def start(self):
+        self._status = "running"                  # BAD: GL205
+
+    def stop(self):
+        self._status = "stopped"                  # BAD: GL205

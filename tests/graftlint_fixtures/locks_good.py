@@ -1,0 +1,76 @@
+# graftlint fixture: disciplined locking that must stay SILENT —
+# including the "helper with the lock held" convention the master
+# components use. Never imported/executed.
+import threading
+import time
+
+
+class GoodStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._epoch = 0
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+            self._bump()
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def clear(self):
+        with self._lock:
+            self._items.clear()
+            self._bump()
+
+    def _bump(self):
+        # private helper called only with the lock held: the entry
+        # lockset is inferred interprocedurally, no finding
+        self._epoch += 1
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._items)
+
+
+class WorkerPool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = []
+
+    def submit(self, job):
+        with self._lock:
+            self._jobs.append(job)
+
+    def drain(self):
+        with self._lock:
+            jobs = list(self._jobs)
+            self._jobs.clear()
+        for job in jobs:
+            job()                  # slow work outside the lock: fine
+
+    def start_background(self):
+        def loop():
+            while True:
+                time.sleep(1)      # nested def runs unlocked: fine
+                self.drain()
+        return loop
+
+
+class OrderedPair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._x = 0
+
+    def one(self):
+        with self._a:
+            with self._b:
+                self._x = 1
+
+    def two(self):
+        with self._a:
+            with self._b:          # same order everywhere: fine
+                self._x = 2

@@ -1,0 +1,65 @@
+# graftlint fixture: deliberate trace-safety violations. Parsed by the
+# analyzer in tests/test_graftlint.py, NEVER imported/executed. Each
+# `# BAD: <rule>` marker line must produce exactly that finding.
+import os
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def branch_on_tracer(x, flag):
+    if flag:                          # BAD: GL101
+        return x + 1
+    while x > 0:                      # BAD: GL101
+        x = x - 1
+    return x
+
+
+@jax.jit
+def impure(x):
+    t = time.time()                   # BAD: GL102
+    n = np.random.normal()            # BAD: GL102
+    r = random.random()               # BAD: GL102
+    s = int(os.environ["SEED"])       # BAD: GL102
+    print("step", x)                  # BAD: GL102
+    return x + t + n + r + s
+
+
+_TRACE_LOG = []
+_COUNTER = 0
+
+
+@jax.jit
+def mutates(x):
+    global _COUNTER                   # BAD: GL103
+    _COUNTER = 1
+    _TRACE_LOG.append(x)              # BAD: GL103
+    return x
+
+
+@jax.jit
+def mutates_imported(x):
+    # mutation of an IMPORTED shared registry at trace time
+    os.environ["TRACED"] = "1"        # BAD: GL102,GL103
+    return x
+
+
+def step(state, batch):
+    return state + batch, state
+
+
+compiled = jax.jit(step)              # BAD: GL104
+
+
+def helper_branch(y, n):
+    if y > n:                         # BAD: GL101
+        return y
+    return n
+
+
+@jax.jit
+def calls_helper(x):
+    return helper_branch(x, 3)

@@ -1,0 +1,65 @@
+# graftlint fixture: trace patterns that must stay SILENT — the safe
+# mirror of every trace_bad.py violation. Never imported/executed.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_branch(x, flag):
+    if flag:                          # static_argnums: a Python bool
+        return x + 1
+    return x
+
+
+@jax.jit
+def shape_branch(x):
+    if x.ndim == 2:                   # shape/dtype resolve at trace time
+        return x.sum()
+    if x is None:                     # `is None` is a trace-time test
+        return jnp.zeros(())
+    return x
+
+
+def helper(y, n):
+    if n > 2:                         # n receives a static closure int
+        return y * n
+    return y
+
+
+BLOCK = 4
+
+
+@jax.jit
+def calls_helper(x):
+    return helper(x, BLOCK)
+
+
+def _quant(x, bits):
+    if bits == 8:                     # partial-bound: a Python constant
+        return x * 2
+    return x
+
+
+quantize = jax.jit(functools.partial(_quant, bits=8))
+
+
+def step(state, batch):
+    return state + batch, state
+
+
+compiled = jax.jit(step, donate_argnums=(0,))    # donated: correct
+
+
+@jax.jit
+def eval_loss(state, batch):
+    # read-only use of state: nothing state-derived is returned whole,
+    # so donation would be WRONG here — GL104 must stay silent
+    return (state * batch).sum()
+
+
+@jax.jit
+def debug_print(x):
+    jax.debug.print("x={}", x)        # the traced-safe print
+    return x

@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""graftlint CLI — trace-safety + lock-discipline gate.
+
+Usage:
+    python tools/graftlint.py dlrover_tpu            # gate (exit 1 on NEW)
+    python tools/graftlint.py --list-rules
+    python tools/graftlint.py --json dlrover_tpu
+    python tools/graftlint.py --write-baseline dlrover_tpu
+    python tools/graftlint.py --no-baseline dlrover_tpu   # full report
+
+Exit codes: 0 = no new findings; 1 = new findings (not in the baseline);
+2 = usage/parse error. The baseline lives at tools/graftlint_baseline.json
+and suppresses accepted pre-existing findings by stable fingerprint —
+see docs/static_analysis.md for when (not) to regenerate it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from dlrover_tpu.analysis import (                       # noqa: E402
+    RULES,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
+                                "graftlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("roots", nargs="*", default=[],
+                        help="package dirs or files to analyze")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline json path")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline; report everything")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept current findings into the baseline")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES.values(), key=lambda r: r.rule_id):
+            print(f"{rule.rule_id}  [{rule.pass_name}] {rule.title}")
+            print(f"        {rule.hint}")
+        return 0
+
+    roots = args.roots or [os.path.join(_REPO_ROOT, "dlrover_tpu")]
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"graftlint: bad baseline: {e}", file=sys.stderr)
+            return 2
+
+    result = run_analysis(roots, baseline=baseline)
+
+    if args.write_baseline:
+        if result.parse_errors:
+            for err in result.parse_errors:
+                print(f"graftlint: parse error: {err}", file=sys.stderr)
+            print("graftlint: refusing to write a baseline from a "
+                  "partially-analyzed tree", file=sys.stderr)
+            return 2
+        try:
+            write_baseline(args.baseline, result)
+        except ValueError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        print(f"graftlint: wrote {len(result.fingerprints)} "
+              f"suppression(s) to {args.baseline}")
+        return 0
+
+    report = result.new_findings if baseline is not None \
+        else result.findings
+    if args.as_json:
+        print(json.dumps({
+            "files_analyzed": result.files_analyzed,
+            "total_findings": len(result.findings),
+            "new_findings": [
+                {"rule_id": f.rule_id, "path": f.path, "line": f.line,
+                 "col": f.col, "message": f.message, "symbol": f.symbol,
+                 "hint": f.rule.hint}
+                for f in report
+            ],
+            "parse_errors": result.parse_errors,
+        }, indent=2))
+    else:
+        for f in report:
+            print(f.format())
+        suppressed = len(result.findings) - len(result.new_findings)
+        tail = (f" ({suppressed} baselined)"
+                if baseline is not None and suppressed else "")
+        print(f"graftlint: {result.files_analyzed} files, "
+              f"{len(report)} finding(s){tail}")
+    for err in result.parse_errors:
+        print(f"graftlint: parse error: {err}", file=sys.stderr)
+    if result.parse_errors:
+        return 2
+    return 1 if report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

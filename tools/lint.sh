@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Lint gate: ruff (style/pyflakes/isort) + graftlint (trace-safety +
+# lock-discipline). Non-zero exit on any NEW finding. Referenced from
+# README's development section; run before sending a PR.
+#
+#   tools/lint.sh             # lint dlrover_tpu (the package)
+#   tools/lint.sh path ...    # lint specific paths
+set -u
+cd "$(dirname "$0")/.."
+
+targets=("$@")
+if [ ${#targets[@]} -eq 0 ]; then
+    targets=(dlrover_tpu)
+fi
+
+rc=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff =="
+    ruff check "${targets[@]}" || rc=1
+else
+    # containers without ruff still get the graftlint gate; config lives
+    # in pyproject.toml [tool.ruff] for environments that have it
+    echo "== ruff == (not installed; skipping)"
+fi
+
+echo "== graftlint =="
+python tools/graftlint.py "${targets[@]}" || rc=1
+
+exit $rc
