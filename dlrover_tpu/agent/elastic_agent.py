@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dlrover_tpu import obs
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.bootstrap import publish_or_wait_coordinator
 from dlrover_tpu.common.constants import (
@@ -102,6 +103,10 @@ class ElasticAgent:
         # elastic restart re-lowers the same programs, so the respawned
         # worker skips compilation — the dominant cost of a fast restore.
         self.compile_cache_dir = os.path.join(self._workdir, "xla-cache")
+        # batches the agent's finished spans (rendezvous etc.) for the
+        # master's job-wide timeline; flushed from the monitor loop
+        self._span_exporter = obs.SpanExporter()
+        obs.add_span_sink(self._span_exporter)
 
     # -- rendezvous --------------------------------------------------------
     def rendezvous(self) -> Tuple[int, Dict[int, int]]:
@@ -109,31 +114,40 @@ class ElasticAgent:
         (reference: MasterRendezvousHandler.next_rendezvous training.py:180).
         """
         spec = self._spec
-        joined_round = self._client.join_rendezvous(
-            spec.devices_per_node, self._rdzv_name)
-        deadline = time.time() + spec.rdzv_timeout_s
-        while time.time() < deadline:
-            rdzv_round, _, world = self._client.get_comm_world(
-                self._rdzv_name
-            )
-            if world and self._client.node_rank in world:
-                self.last_world, self.last_round = world, rdzv_round
-                return rdzv_round, world
-            if rdzv_round > joined_round:
-                # Our round was cut without us — the world was invalidated
-                # by a member death, or node_unit rounding dropped us.
-                # Re-join so the next round can include this node.
-                logger.info(
-                    "rendezvous round %d passed without this node; "
-                    "re-joining", joined_round,
+        # the agent-side rendezvous span is the trace root: the join RPC
+        # carries its context, so the master's rendezvous_join span (and
+        # everything the master hangs beneath it) shares this trace
+        with obs.span("rendezvous",
+                      {"rdzv": self._rdzv_name,
+                       "rank": self._client.node_rank}) as rdzv_span:
+            joined_round = self._client.join_rendezvous(
+                spec.devices_per_node, self._rdzv_name)
+            deadline = time.time() + spec.rdzv_timeout_s
+            while time.time() < deadline:
+                rdzv_round, _, world = self._client.get_comm_world(
+                    self._rdzv_name
                 )
-                joined_round = self._client.join_rendezvous(
-                    spec.devices_per_node, self._rdzv_name)
-            time.sleep(0.5)
-        raise RendezvousTimeoutError(
-            f"rendezvous {self._rdzv_name!r} did not complete within "
-            f"{spec.rdzv_timeout_s:.0f}s"
-        )
+                if world and self._client.node_rank in world:
+                    self.last_world, self.last_round = world, rdzv_round
+                    rdzv_span.set_attr("round", rdzv_round)
+                    rdzv_span.set_attr("world_size", len(world))
+                    return rdzv_round, world
+                if rdzv_round > joined_round:
+                    # Our round was cut without us — the world was
+                    # invalidated by a member death, or node_unit rounding
+                    # dropped us. Re-join so the next round can include
+                    # this node.
+                    logger.info(
+                        "rendezvous round %d passed without this node; "
+                        "re-joining", joined_round,
+                    )
+                    joined_round = self._client.join_rendezvous(
+                        spec.devices_per_node, self._rdzv_name)
+                time.sleep(0.5)
+            raise RendezvousTimeoutError(
+                f"rendezvous {self._rdzv_name!r} did not complete within "
+                f"{spec.rdzv_timeout_s:.0f}s"
+            )
 
     def _bootstrap_env(self, rdzv_round: int,
                        world: Dict[int, int]) -> Dict[str, str]:
@@ -173,6 +187,9 @@ class ElasticAgent:
             self._spec.entrypoint,
         )
         self._proc = subprocess.Popen(self._spec.entrypoint, env=env)
+        obs.get_flight_recorder().record_event(
+            "worker_spawn", round=rdzv_round, world=sorted(world),
+            restart=self._restart_count, pid=self._proc.pid)
 
     def _stop_worker(self) -> None:
         if self._proc is None or self._proc.poll() is not None:
@@ -231,22 +248,38 @@ class ElasticAgent:
     def run(self) -> int:
         """Monitor loop (reference: _invoke_run training.py:429-521).
         Returns the worker's final exit code."""
+        recorder = obs.get_flight_recorder()
+        if threading.current_thread() is threading.main_thread():
+            # postmortem timeline even when the platform SIGTERMs the
+            # agent itself (signal API is main-thread-only)
+            recorder.install_signal_handlers()
+        recorder.install_excepthook()
         self._spawn()
         self._start_monitors()
         try:
             return self._run_loop()
         finally:
             self._stop_monitors()
+            self._flush_telemetry()
+            obs.remove_span_sink(self._span_exporter)
+            recorder.dump(reason="agent-exit")
+
+    def _flush_telemetry(self) -> None:
+        self._span_exporter.flush_to(self._client)
 
     def _run_loop(self) -> int:
         spec = self._spec
         while True:
             time.sleep(spec.monitor_interval_s)
+            self._flush_telemetry()
             code = self._proc.poll()
             if code is not None:
                 if code == 0:
                     logger.info("worker finished successfully")
                     return 0
+                obs.get_flight_recorder().record_event(
+                    "worker_failed", exit_code=code,
+                    restart=self._restart_count)
                 self._client.report_failure(
                     f"worker exit code {code}",
                     level=TrainingMsgLevel.PROCESS_ERROR,
@@ -269,6 +302,7 @@ class ElasticAgent:
             if self._hang_event.is_set():
                 self._hang_event.clear()
                 logger.error("restarting hanged worker")
+                obs.get_flight_recorder().record_event("worker_hang")
                 self._restart_worker(count_against_budget=False)
                 continue
             # Healthy: restart on membership change so the world re-forms
@@ -282,11 +316,14 @@ class ElasticAgent:
                     "%d node(s) waiting: restarting worker to re-form the "
                     "world", waiting,
                 )
+                obs.get_flight_recorder().record_event(
+                    "membership_restart", waiting=waiting)
                 self._restart_worker(count_against_budget=False)
 
     def shutdown(self) -> None:
         self._stop_monitors()
         self._stop_worker()
+        obs.remove_span_sink(self._span_exporter)
 
 
 def apply_jax_platform_env() -> None:

@@ -128,12 +128,15 @@ class MasterClient:
     def join_rendezvous(self, local_world_size: int,
                         rdzv_name: str = RendezvousName.TRAINING) -> int:
         """Returns the rendezvous round this node was placed in."""
+        from dlrover_tpu.obs import current_context
+
         result = self._report_typed(msg.JoinRendezvousRequest(
             node_id=self.node_id,
             node_rank=self.node_rank,
             local_world_size=local_world_size,
             rdzv_name=rdzv_name,
             node_ip=local_ip(),
+            trace=current_context() or {},
         ), msg.JoinRendezvousResult)
         return result.round
 
@@ -241,6 +244,22 @@ class MasterClient:
             param_count=param_count, param_bytes=param_bytes,
             flops_per_step=flops_per_step, batch_size=batch_size,
             seq_len=seq_len,
+        )).success
+
+    def report_telemetry(self, samples=None, spans=None) -> bool:
+        """Push metric samples + finished span dicts to the master's
+        registry/flight recorder (obs/). Best-effort by contract: callers
+        treat a False/raise as droppable telemetry."""
+        import json
+
+        if not samples and not spans:
+            return True
+        return self._report(msg.TelemetryReport(
+            node_id=self.node_id,
+            node_rank=self.node_rank,
+            node_type=self.node_type,
+            samples=list(samples or ()),
+            spans_json=json.dumps(spans) if spans else "",
         )).success
 
     def get_paral_config(self) -> msg.ParallelConfig:

@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from dlrover_tpu import obs
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.config import Context
@@ -85,13 +86,17 @@ class ResourceMonitor:
             memory_mb = psutil.virtual_memory().used / (1 << 20)
         except ImportError:  # psutil is present in the image; belt+braces
             pass
-        return msg.NodeResourceStats(
+        stats = msg.NodeResourceStats(
             node_id=self._client.node_id,
             node_type=self._node_type,
             cpu_percent=cpu_percent,
             memory_mb=memory_mb,
             chip_stats=self._chip_stats(),
         )
+        # same series the master exposes, in the agent's own registry
+        # (local debugging; the RPC report remains the master-side feed)
+        obs.publish_node_stats(stats)
+        return stats
 
     def _chip_stats(self) -> List[msg.ChipStats]:
         """TPU HBM usage via jax memory_stats (the pynvml analog). Only
@@ -171,10 +176,14 @@ class TrainingMonitor:
         return record["ts"] if record else 0.0
 
     def _loop(self) -> None:
+        step_gauge = obs.get_registry().gauge(
+            "dlrover_tpu_agent_reported_step",
+            "Last worker step this agent forwarded to the master")
         while not self._stopped.wait(self._interval_s):
             record = _read_last_step(self._metrics_file)
             if record and record["step"] > self._last_reported:
                 self._last_reported = record["step"]
+                step_gauge.set(record["step"])
                 try:
                     self._client.report_global_step(record["step"])
                 except Exception as e:  # noqa: BLE001

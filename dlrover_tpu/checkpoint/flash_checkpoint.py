@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.parallel.sharding import mesh_shardings
 
@@ -127,13 +128,21 @@ class FlashCheckpointer:
                 logger.warning(
                     "quantize_bits=%d requested but the state has no "
                     "'params' subtree; saving exact dtypes", bits)
-        with self._lock:
-            args = ocp.args.Composite(**{
-                _MODEL_ITEM: ocp.args.StandardSave(state),
-                _DATA_ITEM: ocp.args.JsonSave(data_state),
-            })
-            saved = self._manager.save(step, args=args, force=force)
+        # span covers the synchronous part only (device→host staging +
+        # dispatch); the async commit is awaited in `wait`
+        with obs.span("checkpoint_save",
+                      {"step": step, "forced": force}) as save_span:
+            with self._lock:
+                args = ocp.args.Composite(**{
+                    _MODEL_ITEM: ocp.args.StandardSave(state),
+                    _DATA_ITEM: ocp.args.JsonSave(data_state),
+                })
+                saved = self._manager.save(step, args=args, force=force)
+            save_span.set_attr("saved", saved)
         if saved:
+            obs.get_registry().counter(
+                "dlrover_tpu_checkpoint_saves_total",
+                "Checkpoint saves dispatched").inc()
             logger.info("flash checkpoint: async save started at step %d",
                         step)
         return saved
@@ -149,6 +158,15 @@ class FlashCheckpointer:
         step = self._manager.latest_step()
         if step is None:
             return None
+        with obs.span("checkpoint_restore", {"step": step}):
+            result = self._restore_at(step, abstract_state)
+        obs.get_registry().counter(
+            "dlrover_tpu_checkpoint_restores_total",
+            "Checkpoint restores completed").inc()
+        return result
+
+    def _restore_at(self, step: int, abstract_state: Any
+                    ) -> Tuple[Any, Dict[str, Any], int]:
         # the tiny JSON item first: it says how the state was encoded
         data = self._manager.restore(
             step, args=ocp.args.Composite(**{

@@ -20,6 +20,7 @@ class Context:
 
     def __init__(self):
         self.master_port: int = DefaultValues.MASTER_PORT
+        self.metrics_port: int = DefaultValues.METRICS_PORT
         self.rdzv_timeout_s: float = DefaultValues.RDZV_TIMEOUT_S
         self.rdzv_wait_new_node_s: float = DefaultValues.RDZV_WAIT_NEW_NODE_S
         self.task_timeout_s: float = DefaultValues.TASK_TIMEOUT_S

@@ -140,6 +140,8 @@ class MeshAxis:
 
 class DefaultValues:
     MASTER_PORT = 0                 # 0 → pick a free port
+    METRICS_PORT = 0                # /metrics exposition; 0 → free port,
+    #                                 -1 → disabled
     RDZV_TIMEOUT_S = 600.0
     RDZV_WAIT_NEW_NODE_S = 30.0     # grace window for extra nodes past min
     TASK_TIMEOUT_S = 1800.0

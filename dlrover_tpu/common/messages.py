@@ -174,6 +174,9 @@ class JoinRendezvousRequest(Message):
     local_world_size: int = 1    # devices (chips) on this host
     rdzv_name: str = ""
     node_ip: str = ""
+    # span parent context (obs.current_context()) so the master-side join
+    # span shares the agent's trace; {} = sender predates the field
+    trace: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -417,6 +420,37 @@ class ClusterVersionRequest(Message):
 @dataclass
 class ClusterVersion(Message):
     version: int = 0
+
+
+# --------------------------------------------------------------------------
+# Telemetry (obs/): agent/worker → master metrics + spans
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class MetricSample(Message):
+    """One registry operation to replay on the master's registry."""
+
+    kind: str = "gauge"          # "counter" (inc) | "gauge" (set) |
+    #                              "histogram" (observe)
+    name: str = ""
+    value: float = 0.0
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class TelemetryReport(Message):
+    """Batched metric samples + finished spans from a node (obs/).
+
+    Spans ride as JSON (list of span dicts, `Span.to_dict`) so the
+    payload stays allowlist-friendly and schema-stable across versions.
+    """
+
+    node_id: int = -1
+    node_rank: int = -1
+    node_type: str = ""
+    samples: List[MetricSample] = field(default_factory=list)
+    spans_json: str = ""
 
 
 # --------------------------------------------------------------------------

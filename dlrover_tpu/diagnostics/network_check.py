@@ -74,7 +74,8 @@ def probe_main() -> int:
     data = None
     if n > 1:
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax import shard_map
+
+        from dlrover_tpu.common.jax_compat import shard_map
 
         mesh = Mesh(jax.devices(), ("probe",))
         data = jnp.ones((n, _ALLGATHER_FLOATS), jnp.float32)

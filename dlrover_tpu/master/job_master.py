@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.comm import build_server
 from dlrover_tpu.common.config import Context
 from dlrover_tpu.common.constants import JobStage, RendezvousName
@@ -84,6 +85,8 @@ class JobMaster:
         self._exit_reason = ""
         self.metric_collector = None
         self.auto_scaler = None
+        self._metrics_server = None
+        self.metrics_port = 0
         if job_manager is None and job_args is not None:
             from dlrover_tpu.master.node.event_callback import (
                 PsFailoverCallback,
@@ -168,7 +171,25 @@ class JobMaster:
         if self.auto_scaler is not None:
             self.auto_scaler.start()
         self.task_manager.start_timeout_recovery()
+        self._start_metrics_exporter()
+        # an unhandled master crash still leaves the job timeline on disk
+        obs.get_flight_recorder().install_excepthook()
         logger.info("job master serving on port %d", self.port)
+
+    def _start_metrics_exporter(self) -> None:
+        """Serve the Prometheus exposition (metrics_port: 0 = any free
+        port, negative = disabled). Scrape: GET /metrics — see
+        docs/observability.md."""
+        port = Context.singleton().metrics_port
+        if port < 0:
+            return
+        try:
+            self._metrics_server, self.metrics_port = (
+                obs.start_http_exporter(port=port))
+        except OSError as e:
+            logger.warning("metrics exporter failed to bind: %s", e)
+            return
+        logger.info("metrics exposition on :%d/metrics", self.metrics_port)
 
     def run(self, poll_interval_s: float = 30.0) -> int:
         """Block until the job finishes; returns an exit code (reference:
@@ -218,6 +239,13 @@ class JobMaster:
                 self.auto_scaler.stop()
             if self.job_manager is not None:
                 self.job_manager.stop()
+            if self._metrics_server is not None:
+                self._metrics_server.shutdown()
+                self._metrics_server.server_close()  # release the socket
+            # the master's half of the postmortem timeline
+            obs.get_flight_recorder().record_event(
+                "master_stop", exit_reason=self._exit_reason)
+            obs.get_flight_recorder().dump(reason="master-stop")
             self._server.stop(grace_s)
 
     @property
@@ -254,7 +282,12 @@ def run_master_main(args=None) -> int:
     parser.add_argument("--job-name", default="")
     parser.add_argument("--namespace", default="default")
     parser.add_argument("--brain-addr", default="")
+    parser.add_argument("--metrics-port", type=int,
+                        default=Context.singleton().metrics_port,
+                        help="Prometheus /metrics port (0 = any free "
+                             "port, -1 = disabled)")
     ns = parser.parse_args(args)
+    Context.singleton().update(metrics_port=ns.metrics_port)
     if ns.platform == "k8s":
         from dlrover_tpu.operator.crd import (
             ELASTICJOB_PLURAL,

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.node import NodeGroupResource
@@ -63,6 +64,19 @@ class JobAutoScaler:
 
     # -- one optimization round ----------------------------------------
     def execute_job_optimization(self) -> Optional[ScalePlan]:
+        """One optimize → plan → actuate round, timed as a
+        `scale_decision` span (outcome attr: noop / tuned / scaled)."""
+        with obs.span("scale_decision") as decision:
+            plan = self._execute_job_optimization(decision)
+        outcome = decision.attrs.get("outcome", "noop")
+        obs.get_registry().counter(
+            "dlrover_tpu_scale_decisions_total",
+            "Auto-scaler optimization rounds by outcome",
+            labelnames=("outcome",),
+        ).labels(outcome=outcome).inc()
+        return plan
+
+    def _execute_job_optimization(self, decision) -> Optional[ScalePlan]:
         if self._speed_monitor is not None:
             self._optimizer.stats.add_speed_sample(
                 len(self._job_manager.get_running_workers()),
@@ -83,6 +97,7 @@ class JobAutoScaler:
                 != self.suggested_dataloader_workers):
             self.suggested_dataloader_workers = plan.dataloader_workers
             self.paral_config_version += 1
+            decision.set_attr("outcome", "tuned")
             if self.paral_config_sink is not None:
                 self.paral_config_sink(
                     dataloader_workers=plan.dataloader_workers,
@@ -103,9 +118,12 @@ class JobAutoScaler:
                 worker_args.group_resource.count = group.count
         if scale_plan.empty():
             return None
-        logger.info("auto-scale plan: %s",
-                    {t: g.count
-                     for t, g in scale_plan.node_group_resources.items()})
+        counts = {t: g.count
+                  for t, g in scale_plan.node_group_resources.items()}
+        logger.info("auto-scale plan: %s", counts)
+        decision.set_attr("outcome", "scaled")
+        decision.set_attr("plan", counts)
+        obs.get_flight_recorder().record_event("scale_plan", **counts)
         for node_type, group in scale_plan.node_group_resources.items():
             self._job_manager.scale_node_group(node_type, group.count,
                                                group.node_resource)

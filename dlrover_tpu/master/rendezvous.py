@@ -21,6 +21,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.config import Context
 from dlrover_tpu.common.log import default_logger as logger
 
@@ -112,6 +113,7 @@ class RendezvousManager:
         """Drop a node from membership. ``graceful`` marks a clean exit
         (worker finished): survivors keep running, so the cut world stays
         valid for them and must NOT be invalidated — only a death does."""
+        invalidated_round = None
         with self._lock:
             self._alive_nodes.discard(node_rank)
             self._waiting.pop(node_rank, None)
@@ -131,6 +133,17 @@ class RendezvousManager:
                 )
                 self._latest_world = {}
                 self._on_world_invalidated()
+                invalidated_round = self._rdzv_round - 1
+        # obs sinks run OUTSIDE the manager lock (they take their own)
+        if invalidated_round is not None:
+            obs.get_flight_recorder().record_event(
+                "world_invalidated", rdzv=self.name,
+                dead_rank=node_rank, round=invalidated_round)
+            obs.get_registry().counter(
+                "dlrover_tpu_rendezvous_world_invalidations_total",
+                "Cut worlds invalidated by a member death",
+                labelnames=("rdzv",),
+            ).labels(rdzv=self.name).inc()
 
     def _on_world_invalidated(self) -> None:
         """Hook for subclasses holding state keyed on the cut world
@@ -150,7 +163,12 @@ class RendezvousManager:
                 self._node_ips[node_rank] = node_ip
             if len(self._waiting) == 1:
                 self._latest_round_start = time.time()
-            return self._rdzv_round
+            joined_round = self._rdzv_round
+        obs.get_registry().counter(
+            "dlrover_tpu_rendezvous_joins_total",
+            "join_rendezvous RPCs accepted", labelnames=("rdzv",),
+        ).labels(rdzv=self.name).inc()
+        return joined_round
 
     def leave_waiting(self, node_rank: int) -> None:
         """A joiner abandoning an UNCOMPLETED round (its poll deadline
@@ -169,17 +187,22 @@ class RendezvousManager:
                        ) -> Tuple[int, int, Dict[int, int]]:
         """Poll for the completed world. Returns (round, group, world) —
         empty world while the round is still forming."""
+        cut_info = None
         with self._lock:
             self._last_seen[node_rank] = time.time()
             if self._check_rdzv_completed():
-                self._cut_round()
+                cut_info = self._cut_round()
             # A node still in the waiting list has re-joined for the NEXT
             # round — the latest world is stale for it (it may contain dead
             # peers), so report "still forming".
             if (node_rank in self._latest_world
                     and node_rank not in self._waiting):
-                return self._rdzv_round - 1, 0, dict(self._latest_world)
-            return self._rdzv_round, 0, {}
+                result = self._rdzv_round - 1, 0, dict(self._latest_world)
+            else:
+                result = self._rdzv_round, 0, {}
+        if cut_info is not None:
+            self._emit_round_obs(cut_info)
+        return result
 
     def num_nodes_waiting(self) -> int:
         """Agents restart workers when >0 while healthy (membership change;
@@ -219,8 +242,10 @@ class RendezvousManager:
         unit = max(1, self._params.node_unit)
         return (num // unit) * unit
 
-    def _cut_round(self) -> None:
-        """Select the world for this round (lock held)."""
+    def _cut_round(self):
+        """Select the world for this round (lock held). Returns
+        (duration_s, round_idx, world_size) for the caller to pass to
+        `_emit_round_obs` once the lock is released."""
         size = self._rounded_size(
             min(len(self._waiting), self._params.max_nodes)
         )
@@ -237,6 +262,34 @@ class RendezvousManager:
             "%s rendezvous round %d completed: world=%s",
             self.name, self._rdzv_round - 1, sorted(self._latest_world),
         )
+        duration = max(0.0, time.time() - self._latest_round_start)
+        if self._waiting:
+            # a node_unit remainder stays waiting: it opens the NEXT
+            # forming round now (the len==1 transition in join_rendezvous
+            # will never fire for it, so the next round's span/grace
+            # window must not be timed from the OLD round's first join)
+            self._latest_round_start = time.time()
+        return duration, self._rdzv_round - 1, len(self._latest_world)
+
+    def _emit_round_obs(self, cut_info) -> None:
+        """Round span + counters for a just-cut round. Called AFTER the
+        manager lock is released — span sinks and registry children take
+        their own locks and must never nest under it."""
+        duration_s, round_idx, world_size = cut_info
+        obs.record_span(
+            "rendezvous_round", duration_s,
+            attrs={"rdzv": self.name, "round": round_idx,
+                   "world_size": world_size},
+        )
+        registry = obs.get_registry()
+        registry.counter(
+            "dlrover_tpu_rendezvous_rounds_total",
+            "Completed rendezvous rounds", labelnames=("rdzv",),
+        ).labels(rdzv=self.name).inc()
+        registry.gauge(
+            "dlrover_tpu_rendezvous_world_size",
+            "Node count of the latest cut world", labelnames=("rdzv",),
+        ).labels(rdzv=self.name).set(world_size)
 
     @property
     def latest_world(self) -> Dict[int, int]:
@@ -273,10 +326,12 @@ class NetworkCheckRendezvousManager(RendezvousManager):
 
     def get_comm_world(self, node_rank: int
                        ) -> Tuple[int, int, Dict[int, int]]:
+        cut_info = None
+        result = None
         with self._lock:
             self._last_seen[node_rank] = time.time()
             if self._check_rdzv_completed():
-                self._cut_round()
+                cut_info = self._cut_round()
                 self._groups[self._rdzv_round - 1] = self._group_nodes(
                     self._check_round
                 )
@@ -287,8 +342,13 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 if (node_rank in group
                         and all(r in self._latest_world for r in group)):
                     world = {r: self._latest_world[r] for r in group}
-                    return round_idx, gi, world
-            return self._rdzv_round, 0, {}
+                    result = round_idx, gi, world
+                    break
+            if result is None:
+                result = self._rdzv_round, 0, {}
+        if cut_info is not None:
+            self._emit_round_obs(cut_info)
+        return result
 
     def _on_world_invalidated(self) -> None:
         # Groups are keyed on the cut world; a member death makes the

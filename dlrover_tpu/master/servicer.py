@@ -14,6 +14,7 @@ from typing import Dict, Optional
 
 import grpc
 
+from dlrover_tpu import obs
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.config import Context
 from dlrover_tpu.common.constants import RendezvousName
@@ -162,8 +163,15 @@ class MasterServicer:
             )
         elif isinstance(request, msg.JoinRendezvousRequest):
             mgr = self.rdzv_managers[request.rdzv_name]
-            rdzv_round = mgr.join_rendezvous(
-                request.node_rank, request.local_world_size, request.node_ip)
+            # parent under the agent's span so the cross-process timeline
+            # (agent rendezvous → master join → round cut) shares a trace
+            with obs.span("rendezvous_join",
+                          {"rank": request.node_rank,
+                           "rdzv": request.rdzv_name},
+                          parent=getattr(request, "trace", None) or None):
+                rdzv_round = mgr.join_rendezvous(
+                    request.node_rank, request.local_world_size,
+                    request.node_ip)
             return msg.JoinRendezvousResult(round=rdzv_round)
         elif isinstance(request, msg.LeaveRendezvousRequest):
             mgr = self.rdzv_managers[request.rdzv_name]
@@ -186,6 +194,8 @@ class MasterServicer:
                 self.job_manager.update_node_resource_usage(request)
             if self.metric_collector is not None:
                 self.metric_collector.collect_node_stats(request)
+            # the ResourceMonitor's payload made scrapeable on the master
+            obs.publish_node_stats(request)
         elif isinstance(request, msg.NodeHeartbeat):
             if self.job_manager is not None:
                 self.job_manager.collect_heartbeat(
@@ -226,11 +236,53 @@ class MasterServicer:
                 self.job_manager.collect_model_info(request)
             if self.metric_collector is not None:
                 self.metric_collector.collect_model_info(request)
+            # tokens/s exposition = steps/s × tokens-per-step
+            self.speed_monitor.set_tokens_per_step(
+                request.batch_size * request.seq_len)
+        elif isinstance(request, msg.TelemetryReport):
+            self._ingest_telemetry(request)
         else:
             logger.warning("report: unknown request %s",
                            type(request).__name__)
             ok, reason = False, "unknown request"
         return msg.Response(success=ok, reason=reason)
+
+    # ------------------------------------------------------------------
+    def _ingest_telemetry(self, report: msg.TelemetryReport) -> None:
+        """Replay a node's metric samples on the master registry and feed
+        its spans into the master flight recorder + span histogram."""
+        import json
+
+        registry = obs.get_registry()
+        for sample in report.samples:
+            if not sample.name:
+                continue
+            labels = dict(sample.labels)
+            labels.setdefault("node", str(report.node_id))
+            try:
+                names = tuple(sorted(labels))
+                if sample.kind == "counter":
+                    registry.counter(sample.name, labelnames=names).labels(
+                        **labels).inc(sample.value)
+                elif sample.kind == "histogram":
+                    registry.histogram(sample.name,
+                                       labelnames=names).labels(
+                        **labels).observe(sample.value)
+                else:
+                    registry.gauge(sample.name, labelnames=names).labels(
+                        **labels).set(sample.value)
+            except (TypeError, ValueError) as e:
+                logger.warning("telemetry sample %s dropped: %s",
+                               sample.name, e)
+        if report.spans_json:
+            try:
+                spans = json.loads(report.spans_json)
+            except json.JSONDecodeError:
+                logger.warning("telemetry spans from node %d undecodable",
+                               report.node_id)
+                return
+            if isinstance(spans, list):
+                obs.record_remote_spans(spans, registry)
 
     # ------------------------------------------------------------------
     def _touch_rendezvous(self, node_rank: int) -> None:

@@ -4,6 +4,15 @@ Capability parity: dlrover/python/master/monitor/speed_monitor.py:43 —
 collect (timestamp, global_step) samples, compute windowed throughput,
 track per-worker step reports, and flag a hang when no step progress is made
 for `hang_seconds`.
+
+Publishes through the obs metrics registry (docs/observability.md):
+``dlrover_tpu_training_global_step`` / ``_steps_per_second`` /
+``_tokens_per_second`` collect-time gauges and the
+``dlrover_tpu_train_step_time_seconds`` histogram observed per step
+report. All shared step/worker state is written from servicer threads
+and read from the master watch loop + metrics scrapes — every access
+goes through ``self._lock``; registry observes happen OUTSIDE the lock
+(sinks must never run under it).
 """
 
 from __future__ import annotations
@@ -13,6 +22,7 @@ import time
 from collections import deque
 from typing import Deque, Dict, Optional, Set, Tuple
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.config import Context
 
 
@@ -30,19 +40,60 @@ class SpeedMonitor:
         self._worker_steps: Dict[int, int] = {}
         self._start_training_time: Optional[float] = None
         self._paused_time_s: float = 0.0
+        self._tokens_per_step: int = 0
+        # set at membership change: the NEXT step-report delta spans the
+        # failover gap (rendezvous + recompile + restore), not step time
+        self._skip_next_step_time = False
+        self._publish_metrics()
+
+    def _publish_metrics(self) -> None:
+        """Collect-time gauges: scrapes read live values through the
+        monitor's own locked queries (the newest monitor instance in a
+        process wins the registration — matching the newest master)."""
+        registry = obs.get_registry()
+        registry.gauge(
+            "dlrover_tpu_training_global_step",
+            "Latest global step reported by any worker",
+        ).set_function(lambda: self.completed_global_step)
+        registry.gauge(
+            "dlrover_tpu_training_steps_per_second",
+            "Windowed training throughput",
+        ).set_function(self.running_speed)
+        registry.gauge(
+            "dlrover_tpu_training_tokens_per_second",
+            "Windowed throughput x tokens per step (from ModelInfo)",
+        ).set_function(self.tokens_per_second)
+        registry.gauge(
+            "dlrover_tpu_training_running_workers",
+            "Workers currently joined on the master",
+        ).set_function(lambda: self.num_running_workers)
+        self._step_time_hist = registry.histogram(
+            "dlrover_tpu_train_step_time_seconds",
+            "Wall-clock per training step, from step-report deltas",
+        )
 
     # -- sample collection -------------------------------------------------
     def collect_global_step(self, step: int,
                             timestamp: Optional[float] = None) -> None:
         timestamp = timestamp or time.time()
+        step_time: Optional[float] = None
         with self._lock:
             if step <= self._global_step:
                 return
             if self._first_step_time is None:
                 self._first_step_time = timestamp
+            elif self._skip_next_step_time:
+                # this delta spans the failover gap, not training
+                self._skip_next_step_time = False
+            elif timestamp > self._last_step_time:
+                # mean per-step wall time since the previous report
+                step_time = ((timestamp - self._last_step_time)
+                             / (step - self._global_step))
             self._global_step = step
             self._last_step_time = timestamp
             self._samples.append((timestamp, step))
+        if step_time is not None:
+            self._step_time_hist.observe(step_time)
 
     def collect_worker_step(self, worker_id: int, step: int) -> None:
         with self._lock:
@@ -54,11 +105,23 @@ class SpeedMonitor:
             if self._start_training_time is None:
                 self._start_training_time = time.time()
 
+    def set_tokens_per_step(self, tokens: int) -> None:
+        """From ModelInfo (batch_size × seq_len): scales steps/s into the
+        tokens/s exposition series."""
+        with self._lock:
+            if tokens > 0:
+                self._tokens_per_step = int(tokens)
+
     # -- queries -----------------------------------------------------------
     @property
     def completed_global_step(self) -> int:
         with self._lock:
             return self._global_step
+
+    @property
+    def num_running_workers(self) -> int:
+        with self._lock:
+            return len(self._workers)
 
     def running_speed(self) -> float:
         """Steps/second over the sample window."""
@@ -69,6 +132,11 @@ class SpeedMonitor:
             if t1 <= t0:
                 return 0.0
             return (s1 - s0) / (t1 - t0)
+
+    def tokens_per_second(self) -> float:
+        with self._lock:
+            tokens = self._tokens_per_step
+        return self.running_speed() * tokens
 
     def all_worker_joined(self, expected: int) -> bool:
         with self._lock:
@@ -92,6 +160,9 @@ class SpeedMonitor:
             return (time.time() - self._last_step_time) > hang_seconds
 
     def reset_running_speed(self) -> None:
-        """Call at membership change: old samples reflect the old world."""
+        """Call at membership change: old samples reflect the old world,
+        and the next step-report delta spans the failover gap — neither
+        belongs in the steady-state series."""
         with self._lock:
             self._samples.clear()
+            self._skip_next_step_time = True

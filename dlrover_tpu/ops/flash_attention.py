@@ -33,6 +33,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dlrover_tpu.common.jax_compat import (
+    get_vma,
+    shape_dtype_struct,
+    shard_map,
+    tpu_compiler_params,
+)
+
 NEG_INF = -1e30
 
 # exp2-domain softmax: fold log2(e) into the score scale so every
@@ -52,7 +59,7 @@ DEFAULT_BLOCK_K = 1024
 
 # Grid axes (batch, heads, outer-block) are independent; the innermost
 # axis carries the VMEM accumulators and must stay sequential.
-_DIM_SEMANTICS = pltpu.CompilerParams(
+_DIM_SEMANTICS = tpu_compiler_params(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
@@ -66,12 +73,12 @@ def _vma(*arrays) -> frozenset:
     outputs must declare how they vary."""
     u: frozenset = frozenset()
     for a in arrays:
-        u = u | getattr(jax.typeof(a), "vma", frozenset())
+        u = u | get_vma(a)
     return u
 
 
 def _sds(shape, dtype, vma):
-    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return shape_dtype_struct(shape, dtype, vma=vma)
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -544,7 +551,6 @@ def mesh_flash_attention(q, k, v, causal: bool = True,
     when no relevant axis is >1, or when the shapes don't divide (XLA
     then reports the partitioning failure loudly rather than silently
     replicating)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from dlrover_tpu.common.constants import MeshAxis

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from dlrover_tpu.common.jax_compat import shape_dtype_struct
 from dlrover_tpu.ops.flash_attention import _vma
 
 
@@ -99,9 +100,9 @@ def _rms_fwd(x, weight, eps):
             pl.BlockSpec((block, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, x.dtype, vma=_vma(x2, weight)),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32,
-                                 vma=_vma(x2, weight)),
+            shape_dtype_struct(x2.shape, x.dtype, vma=_vma(x2, weight)),
+            shape_dtype_struct((rows, 1), jnp.float32,
+                               vma=_vma(x2, weight)),
         ],
         interpret=_use_interpret(),
     )(x2, weight)
@@ -133,10 +134,10 @@ def _rms_bwd_vjp(eps, res, g):
             pl.BlockSpec((8, dim), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, x2.dtype,
-                                 vma=_vma(x2, weight, g2)),
-            jax.ShapeDtypeStruct((8, dim), jnp.float32,
-                                 vma=_vma(x2, weight, g2)),
+            shape_dtype_struct(x2.shape, x2.dtype,
+                               vma=_vma(x2, weight, g2)),
+            shape_dtype_struct((8, dim), jnp.float32,
+                               vma=_vma(x2, weight, g2)),
         ],
         interpret=_use_interpret(),
     )(x2, weight, rstd, g2)

@@ -34,10 +34,10 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dlrover_tpu.common.constants import MeshAxis
+from dlrover_tpu.common.jax_compat import get_vma, shard_map
 
 
 def _pipeline_local(stage_params, in_store, *, stage_fn, axis_name: str,
@@ -61,8 +61,7 @@ def _pipeline_local(stage_params, in_store, *, stage_fn, axis_name: str,
     micro_shape = in_store.shape[1:]
     # carries hold per-stage values: mark them varying over the pipe axis
     # so the vma check accepts the ppermute outputs fed back into the scan
-    zeros = lax.pcast(jnp.zeros(micro_shape, in_store.dtype), (axis_name,),
-                      to="varying")
+    zeros = _varying(jnp.zeros(micro_shape, in_store.dtype), axis_name)
     out_store0 = jnp.zeros_like(in_store)  # varying: derived from in_store
 
     fwd_perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
@@ -171,13 +170,14 @@ def pipeline_apply(
 
 
 def _varying(x, axis_name):
-    """Mark x as varying over the pipe axis (idempotent)."""
-    try:
-        if axis_name in jax.typeof(x).vma:
-            return x
-    except AttributeError:
-        pass
-    return lax.pcast(x, (axis_name,), to="varying")
+    """Mark x as varying over the pipe axis (idempotent). On runtimes
+    without vma tracking (no lax.pcast) there is nothing to mark."""
+    if axis_name in get_vma(x):
+        return x
+    pcast = getattr(lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, (axis_name,), to="varying")
 
 
 def pipeline_train(

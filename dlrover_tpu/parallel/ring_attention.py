@@ -33,9 +33,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from dlrover_tpu.common.constants import MeshAxis
+from dlrover_tpu.common.jax_compat import shard_map
 
 _NEG_INF = -1e30
 

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 import jax
 import numpy as np
 
+from dlrover_tpu import obs
 from dlrover_tpu.checkpoint import FlashCheckpointer
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh, dp_size
@@ -82,6 +83,21 @@ class ElasticTrainLoop:
         making pipeline training elastic with checkpoint-resume."""
         self.config = config
         self.client = master_client
+        # finished spans batched for the master (flushed at report
+        # intervals); registered before any span below so the recompile
+        # span of THIS (re)build is part of the shipped timeline. A
+        # failed construction must deregister (the global sink list
+        # outlives this instance).
+        self._span_exporter = obs.SpanExporter()
+        obs.add_span_sink(self._span_exporter)
+        try:
+            self._init_inner(model, tx, loss_fn, config, devices, trainer)
+        except BaseException:
+            obs.remove_span_sink(self._span_exporter)
+            raise
+
+    def _init_inner(self, model, tx, loss_fn, config, devices,
+                    trainer) -> None:
         if trainer is not None:
             self.trainer = trainer
             self.mesh = trainer.mesh
@@ -99,11 +115,18 @@ class ElasticTrainLoop:
 
             sample = jnp.zeros((self.micro_global, config.seq_len),
                                jnp.int32)
-            self.trainer = build_trainer(
-                model, tx, self.mesh, sample, loss_fn,
-                accum_steps=self.accum, micro_batch=self.micro_global,
-                rules=config.rules,
-            )
+            # the re-lower after an elastic resize: trace + shardings +
+            # jit wrappers for THIS world shape (XLA compile itself lands
+            # in the recompile/aot span, train_step.precompile)
+            with obs.span("recompile",
+                          {"phase": "relower",
+                           "devices": self.dp,
+                           "mesh": dict(self.mesh.shape)}):
+                self.trainer = build_trainer(
+                    model, tx, self.mesh, sample, loss_fn,
+                    accum_steps=self.accum, micro_batch=self.micro_global,
+                    rules=config.rules,
+                )
         self.checkpointer = (
             FlashCheckpointer(config.checkpoint_dir,
                               config.save_interval_steps,
@@ -149,6 +172,9 @@ class ElasticTrainLoop:
 
         def _handler(signum, frame):
             logger.info("SIGTERM: will checkpoint and stop after this step")
+            recorder = obs.get_flight_recorder()
+            recorder.record_event("sigterm", pid=os.getpid())
+            recorder.dump(reason="sigterm")
             self._stop_requested.set()
 
         self._prev_sigterm = signal.signal(signal.SIGTERM, _handler)
@@ -173,51 +199,59 @@ class ElasticTrainLoop:
 
         timings: Dict[str, float] = {}
         self.last_restore_timings = timings
-        compile_thread = None
-        if (self.config.overlap_restore_compile
-                and hasattr(self.trainer, "precompile")):
-            compile_thread = threading.Thread(
-                target=self._precompile_quietly, daemon=True)
-            t_compile_start = _time.monotonic()
-            compile_thread.start()
-        if self.checkpointer is None:
-            state, step = self.trainer.init(rng), 0
-        else:
-            t0 = _time.monotonic()
-            abstract = self.trainer.abstract_state(rng)
-            timings["abstract_state_s"] = round(_time.monotonic() - t0, 2)
-            t0 = _time.monotonic()
-            restored = self.checkpointer.restore(abstract)
-            timings["orbax_read_s"] = round(_time.monotonic() - t0, 2)
-            if restored is None:
+        with obs.span("restore_or_init") as restore_span:
+            compile_thread = None
+            if (self.config.overlap_restore_compile
+                    and hasattr(self.trainer, "precompile")):
+                compile_thread = threading.Thread(
+                    target=self._precompile_quietly, daemon=True)
+                t_compile_start = _time.monotonic()
+                compile_thread.start()
+            if self.checkpointer is None:
                 state, step = self.trainer.init(rng), 0
             else:
-                state, data_state, step = restored
-                # split the read from any deferred host->device transfer
-                # (remote-execution backends materialize lazily)
                 t0 = _time.monotonic()
-                jax.block_until_ready(state)
-                timings["device_ready_s"] = round(
+                abstract = self.trainer.abstract_state(rng)
+                timings["abstract_state_s"] = round(
                     _time.monotonic() - t0, 2)
-                if sampler is not None and "sampler" in data_state:
-                    sampler.load_state_dict(data_state["sampler"])
-                if self.client is not None and data_state.get("shards"):
-                    try:
-                        self.client.report_shard_checkpoint(
-                            data_state["shards"])
-                    except Exception:
-                        logger.warning(
-                            "could not restore master shard checkpoint")
-        if compile_thread is not None:
-            t0 = _time.monotonic()
-            compile_thread.join()
-            timings["compile_wait_after_read_s"] = round(
-                _time.monotonic() - t0, 2)
-            timings["compile_total_s"] = round(
-                _time.monotonic() - t_compile_start, 2)
-            timings.update(getattr(self.trainer, "precompile_timings", {}))
+                t0 = _time.monotonic()
+                restored = self.checkpointer.restore(abstract)
+                timings["orbax_read_s"] = round(_time.monotonic() - t0, 2)
+                if restored is None:
+                    state, step = self.trainer.init(rng), 0
+                else:
+                    state, data_state, step = restored
+                    # split the read from any deferred host->device
+                    # transfer (remote-execution backends materialize
+                    # lazily)
+                    t0 = _time.monotonic()
+                    jax.block_until_ready(state)
+                    timings["device_ready_s"] = round(
+                        _time.monotonic() - t0, 2)
+                    if sampler is not None and "sampler" in data_state:
+                        sampler.load_state_dict(data_state["sampler"])
+                    if self.client is not None and data_state.get("shards"):
+                        try:
+                            self.client.report_shard_checkpoint(
+                                data_state["shards"])
+                        except Exception:
+                            logger.warning(
+                                "could not restore master shard checkpoint")
+            if compile_thread is not None:
+                t0 = _time.monotonic()
+                compile_thread.join()
+                timings["compile_wait_after_read_s"] = round(
+                    _time.monotonic() - t0, 2)
+                timings["compile_total_s"] = round(
+                    _time.monotonic() - t_compile_start, 2)
+                timings.update(
+                    getattr(self.trainer, "precompile_timings", {}))
+            restore_span.set_attr("start_step", step)
+            for key, value in timings.items():
+                restore_span.set_attr(key, value)
         if timings:
             logger.info("restore timings: %s", timings)
+        self._flush_telemetry()
         return state, step
 
     def _precompile_quietly(self) -> None:
@@ -253,13 +287,20 @@ class ElasticTrainLoop:
 
     def _run_inner(self, state, batches, start_step, sampler,
                    raw_metrics):
+        import time as _time
+
         config = self.config
         step = start_step
         if self._chaos is None:
             from dlrover_tpu.diagnostics.chaos import ChaosInjector
 
             self._chaos = ChaosInjector()
+        step_hist = obs.get_registry().histogram(
+            "dlrover_tpu_worker_step_seconds",
+            "Host wall-clock per train-loop iteration (dispatch-bound "
+            "unless a host sync lands in the step)")
         for tokens, targets in batches:
+            t_step = _time.monotonic()
             self._maybe_profile(step - start_step)
             tok, tgt = self.trainer.shard_batch(tokens, targets)
             state, raw_metrics = self.trainer.step(state, tok, tgt)
@@ -268,12 +309,14 @@ class ElasticTrainLoop:
             self._chaos.maybe_inject(step)
             if sampler is not None:
                 sampler.record_batch(config.global_batch)
+            step_hist.observe(_time.monotonic() - t_step)
             if (self.client is not None
                     and step % config.report_interval_steps == 0):
                 try:
                     self.client.report_global_step(step)
                 except Exception:
                     pass
+                self._flush_telemetry()
             if self.checkpointer is not None:
                 forced = self._stop_requested.is_set()
                 self.checkpointer.maybe_save(
@@ -281,16 +324,24 @@ class ElasticTrainLoop:
                 )
             if self._stop_requested.is_set():
                 logger.info("stopping at step %d on request", step)
+                obs.get_flight_recorder().record_event(
+                    "train_stop_requested", step=step)
                 break
             if config.max_steps and step - start_step >= config.max_steps:
                 break
-        metrics = {k: float(v) for k, v in raw_metrics.items()}
+        # the device→host sync point: converting metrics blocks on the
+        # last step's results (the only host sync the steady-state loop
+        # pays — worth a span so slow syncs are visible in postmortems)
+        with obs.span("host_sync", {"step": step}):
+            metrics = {k: float(v) for k, v in raw_metrics.items()}
         # the step actually REACHED (an early stop — SIGTERM, exhausted
         # data — ends below start_step + max_steps; callers must not
         # assume the request was met)
         metrics["step"] = float(step)
         if self.checkpointer is not None:
-            self.checkpointer.wait()
+            with obs.span("checkpoint_wait"):
+                self.checkpointer.wait()
+        self._flush_telemetry()
         return state, metrics
 
     # -- profiling ---------------------------------------------------------
@@ -327,7 +378,13 @@ class ElasticTrainLoop:
                 pass
         return data_state
 
+    def _flush_telemetry(self) -> None:
+        if self.client is not None:
+            self._span_exporter.flush_to(self.client)
+
     def close(self) -> None:
+        self._flush_telemetry()
+        obs.remove_span_sink(self._span_exporter)
         if self.checkpointer is not None:
             self.checkpointer.close()
         if self._prev_sigterm is not None:

@@ -501,15 +501,18 @@ class PipelinedTrainer:
         self.state_shardings = jax.tree_util.tree_map_with_path(
             for_path, abstract)
         if self._offload:
+            from dlrover_tpu.common.jax_compat import host_memory_kind
+
             # optimizer moments live in HOST memory (same mechanism as
             # build_trainer's offload_opt_state: pinned_host memory kind
             # on the shardings; XLA inserts the host↔HBM transfers
             # around the update). Scalars stay on device — the SPMD
             # partitioner rejects memory kinds on them.
+            host_kind = host_memory_kind(self.mesh.devices.flat[0])
             self.state_shardings = self.state_shardings.replace(
                 opt_state=jax.tree.map(
                     lambda s, a: s if a.ndim == 0 else NamedSharding(
-                        self.mesh, s.spec, memory_kind="pinned_host"),
+                        self.mesh, s.spec, memory_kind=host_kind),
                     self.state_shardings.opt_state, abstract.opt_state,
                 ))
 

@@ -86,18 +86,26 @@ class ShardedTrainer:
             return
         import time as _time
 
+        from dlrover_tpu import obs
+
         abstract = self.abstract_state(
             jax.random.PRNGKey(0) if rng is None else rng)
-        t0 = _time.monotonic()
-        lowered = self.step_fn.lower(
-            abstract, self.batch_abstract, self.batch_abstract)
-        t1 = _time.monotonic()
-        compiled = lowered.compile()
-        t2 = _time.monotonic()
-        self.precompile_timings = {
-            "trace_lower_s": round(t1 - t0, 2),
-            "compile_or_cache_load_s": round(t2 - t1, 2),
-        }
+        with obs.span("recompile", {"phase": "aot"}) as aot_span:
+            t0 = _time.monotonic()
+            lowered = self.step_fn.lower(
+                abstract, self.batch_abstract, self.batch_abstract)
+            t1 = _time.monotonic()
+            compiled = lowered.compile()
+            t2 = _time.monotonic()
+            self.precompile_timings = {
+                "trace_lower_s": round(t1 - t0, 2),
+                "compile_or_cache_load_s": round(t2 - t1, 2),
+            }
+            aot_span.set_attr("trace_lower_s",
+                              self.precompile_timings["trace_lower_s"])
+            aot_span.set_attr(
+                "compile_or_cache_load_s",
+                self.precompile_timings["compile_or_cache_load_s"])
         self._compiled_step = compiled
 
     def step(self, state: TrainState, tokens, targets):
@@ -191,13 +199,16 @@ def build_trainer(
     state_shardings = sanitize_shardings(
         state_shardings, nn.unbox(abstract_boxed), mesh)
     if offload_opt_state:
+        from dlrover_tpu.common.jax_compat import host_memory_kind
+
+        host_kind = host_memory_kind(mesh.devices.flat[0])
         abstract_opt = nn.unbox(abstract_boxed).opt_state
         state_shardings = state_shardings.replace(
             opt_state=jax.tree.map(
                 # scalars (step counters) stay on device: XLA's SPMD
                 # partitioner rejects memory-kind annotations on them
                 lambda s, a: s if a.ndim == 0 else NamedSharding(
-                    mesh, s.spec, memory_kind="pinned_host"),
+                    mesh, s.spec, memory_kind=host_kind),
                 state_shardings.opt_state, abstract_opt,
             ))
     # Batch (accum, micro, seq): micro over the joint dp axes, seq over the
@@ -277,9 +288,22 @@ def build_trainer(
         return new_state, metrics
 
     n_reduce = mesh.shape.get(grad_reduce_axis, 1)
+    from dlrover_tpu.common.jax_compat import HAS_PARTIAL_AUTO, shard_map
+
+    if (grad_reduce_bits and n_reduce > 1 and not HAS_PARTIAL_AUTO
+            and len([a for a, n in mesh.shape.items() if n > 1]) > 1):
+        # the quantized reduce needs a shard_map manual over ONE axis of a
+        # multi-axis mesh; without partial-auto support that program
+        # cannot be built — train exactly instead of not at all
+        from dlrover_tpu.common.log import default_logger
+
+        default_logger.warning(
+            "grad_reduce_bits=%d requested but this jax has no "
+            "partial-auto shard_map; falling back to the exact reduce",
+            grad_reduce_bits)
+        grad_reduce_bits = 0
     if grad_reduce_bits and n_reduce > 1:
         from jax.sharding import PartitionSpec
-        from jax import shard_map
 
         from dlrover_tpu.parallel.quant_collectives import quantized_pmean
 

@@ -22,6 +22,7 @@ from dlrover_tpu.auto.engine.analyser import analyse
 from dlrover_tpu.auto.engine.dry_runner import dry_run
 from dlrover_tpu.auto.engine.planner import plan_candidates
 from dlrover_tpu.common.constants import MeshAxis
+from dlrover_tpu.common.jax_compat import HAS_PARTIAL_AUTO
 from dlrover_tpu.models.gpt import GPT, GPTConfig
 from dlrover_tpu.models.llama import Llama, LlamaConfig, cross_entropy_loss
 
@@ -381,6 +382,9 @@ class TestEngine:
         _, metrics = result.step(state, tok, tgt)
         assert np.isfinite(float(metrics["loss"]))
 
+    @pytest.mark.skipif(
+        not HAS_PARTIAL_AUTO,
+        reason="pipeline needs partial-auto shard_map (jax.shard_map)")
     def test_deep_model_gets_sized_pipeline_candidate(self, monkeypatch,
                                                       cpu_devices):
         """VERDICT round-3 item 4's second done bar: a deep model that
@@ -408,6 +412,9 @@ class TestEngine:
         speed, err = dry_run(context, pp[0], warmup=1, steps=1)
         assert err == "" and speed > 0
 
+    @pytest.mark.skipif(
+        not HAS_PARTIAL_AUTO,
+        reason="pipeline needs partial-auto shard_map (jax.shard_map)")
     def test_moe_deep_model_gets_expert_pipe_candidate(self, monkeypatch,
                                                        cpu_devices):
         """A deep MoE model that doesn't fit one device plans an

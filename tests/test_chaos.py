@@ -92,6 +92,7 @@ def _run_chaos_job(tmp_path, script, train_args,
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_scripted_chaos_kill_recovers(tmp_path):
     """The chaos-run twin of the reference's start_chaos.sh: launch the
     real CLI job with a kill fault armed; the worker SIGKILLs itself,
@@ -109,6 +110,7 @@ def test_scripted_chaos_kill_recovers(tmp_path):
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_chaos_kill_recovers_streaming_trainer(tmp_path):
     """Kill-recovery for the streaming (>HBM per-layer) path: the chaos
     fault SIGKILLs the streaming worker mid-run, the agent respawns it,

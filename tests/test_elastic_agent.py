@@ -17,7 +17,10 @@ from dlrover_tpu.master.job_master import JobMaster
 
 # every test here spawns subprocesses (agents, workers, jax.distributed
 # groups) — minutes-slow; the fast unit core runs with -m "not e2e"
-pytestmark = pytest.mark.e2e
+# subprocess e2e stack (agents spawning cold-compiling jax workers) —
+# minutes-slow; excluded from tier-1 (-m "not slow") like the other
+# subprocess suites so the gate fits its 870 s budget
+pytestmark = [pytest.mark.e2e, pytest.mark.slow]
 
 
 @pytest.fixture()

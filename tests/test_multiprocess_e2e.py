@@ -15,8 +15,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # every test here spawns subprocesses (agents, workers, jax.distributed
-# groups) — minutes-slow; the fast unit core runs with -m "not e2e"
-pytestmark = pytest.mark.e2e
+# groups) — minutes-slow; excluded from tier-1 (-m "not slow") and from
+# the fast unit core (-m "not e2e")
+pytestmark = [pytest.mark.e2e, pytest.mark.slow]
 
 WORKER = """
 from dlrover_tpu.agent.elastic_agent import init_distributed

@@ -8,7 +8,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import lax, shard_map
+from jax import lax
+
+from dlrover_tpu.common.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dlrover_tpu.models.llama import (

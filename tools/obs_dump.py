@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""Pretty-print a flight-recorder JSON dump as a postmortem timeline.
+
+Usage:
+    python tools/obs_dump.py /tmp/dlrover-tpu-flight/flight-worker-123.json
+    python tools/obs_dump.py --spans-only dump.json      # hide raw events
+    python tools/obs_dump.py --name rendezvous dump.json # filter by name
+
+Output: one line per record, time-ordered relative to the first record —
+    +12.304s  SPAN   rendezvous_round                0.512s  ok  round=3
+    +13.001s  EVENT  worker_spawn                               pid=4242
+
+Exit codes: 0 ok; 2 on unreadable/invalid dump files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from datetime import datetime, timezone
+
+
+def _fmt_attrs(attrs: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+
+
+def render(payload: dict, spans_only: bool = False,
+           name_filter: str = "") -> str:
+    events = payload.get("events", [])
+    lines = [
+        "flight recorder dump: role={role} pid={pid} host={host} "
+        "reason={reason}".format(
+            role=payload.get("role", "?"), pid=payload.get("pid", "?"),
+            host=payload.get("host", "?"),
+            reason=payload.get("reason", "?")),
+        "dumped at: " + datetime.fromtimestamp(
+            payload.get("dumped_at", 0), timezone.utc).isoformat(),
+        f"records: {len(events)}",
+        "",
+    ]
+    t0 = events[0].get("ts", 0.0) if events else 0.0
+    shown = 0
+    for record in events:
+        kind = record.get("kind", "event")
+        if spans_only and kind != "span":
+            continue
+        name = str(record.get("name", "?"))
+        if name_filter and name_filter not in name:
+            continue
+        shown += 1
+        offset = record.get("ts", 0.0) - t0
+        attrs = _fmt_attrs(record.get("attrs", {}))
+        if kind == "span":
+            duration = record.get("duration_s", 0.0)
+            status = record.get("status", "ok")
+            lines.append(
+                f"+{offset:9.3f}s  SPAN   {name:<28} "
+                f"{duration:8.3f}s  {status:<5} {attrs}".rstrip())
+        else:
+            lines.append(
+                f"+{offset:9.3f}s  EVENT  {name:<28} "
+                f"{'':10} {attrs}".rstrip())
+    if name_filter or spans_only:
+        lines.append("")
+        lines.append(f"shown: {shown}/{len(events)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "obs_dump", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="+",
+                        help="flight-recorder JSON dump file(s)")
+    parser.add_argument("--spans-only", action="store_true",
+                        help="show only span records")
+    parser.add_argument("--name", default="",
+                        help="substring filter on record names")
+    ns = parser.parse_args(argv)
+    status = 0
+    for path in ns.paths:
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{path}: unreadable dump: {e}", file=sys.stderr)
+            status = 2
+            continue
+        if len(ns.paths) > 1:
+            print(f"== {path}")
+        print(render(payload, ns.spans_only, ns.name))
+    return status
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
