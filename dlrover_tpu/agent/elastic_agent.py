@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+import grpc
+
 from dlrover_tpu import obs
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.bootstrap import publish_or_wait_coordinator
@@ -56,6 +58,10 @@ class WorkerSpec:
     # restart the worker when step progress stalls (atorch
     # --relaunch_on_hanging analog)
     relaunch_on_hanging: bool = False
+    # consecutive failed num_nodes_waiting polls (each already a full
+    # retry_rpc budget) before declaring the master lost and entering
+    # the degraded reconnect loop
+    master_lost_after_polls: int = 2
 
     def __post_init__(self) -> None:
         # THIS interval (not Context.monitor_interval_s, an independent
@@ -78,6 +84,10 @@ class RendezvousTimeoutError(TimeoutError):
     pass
 
 
+class MasterLostError(RuntimeError):
+    """The master stayed unreachable past the reconnect budget."""
+
+
 class ElasticAgent:
     """Joins the master rendezvous and keeps one training process alive."""
 
@@ -87,6 +97,10 @@ class ElasticAgent:
         self._spec = spec
         self._rdzv_name = rdzv_name
         self._restart_count = 0
+        self._master_fail_streak = 0
+        # set by shutdown(): the run loop must not resurrect the worker
+        # it just killed, and reconnect loops must stop dialing
+        self._shutdown = threading.Event()
         self._proc: Optional[subprocess.Popen] = None
         self.last_world: Dict[int, int] = {}
         self.last_round = -1
@@ -201,6 +215,25 @@ class ElasticAgent:
             self._proc.kill()
             self._proc.wait()
 
+    def _restart_worker_resilient(self, count_against_budget: bool
+                                  ) -> None:
+        """_restart_worker, but a restart whose own rendezvous cannot
+        reach the master falls into master-lost handling: a worker crash
+        DURING a master outage gets the full reconnect budget
+        (master_reconnect_timeout_s), not just one RPC retry budget.
+        After reconnection the resync sees the dead worker and respawns
+        it. ONLY transport errors divert: a RendezvousTimeoutError
+        (master answered, world never formed) or a spawn failure
+        (Popen OSError — the entrypoint itself is broken, and retrying
+        against a healthy master would loop forever) propagates."""
+        try:
+            self._restart_worker(count_against_budget)
+        except grpc.RpcError as exc:
+            logger.warning(
+                "worker restart could not reach the master (%s); "
+                "entering master-lost mode", exc)
+            self._handle_master_loss()
+
     def _restart_worker(self, count_against_budget: bool) -> None:
         """Membership-change restarts are normal elasticity and do NOT
         consume the failure budget (reference: torchelastic only charges
@@ -258,6 +291,11 @@ class ElasticAgent:
         self._start_monitors()
         try:
             return self._run_loop()
+        except BaseException:
+            # master-lost (and only master-lost) paths can raise with a
+            # LIVE worker — never orphan the trainer on the way out
+            self._stop_worker()
+            raise
         finally:
             self._stop_monitors()
             self._flush_telemetry()
@@ -271,20 +309,29 @@ class ElasticAgent:
         spec = self._spec
         while True:
             time.sleep(spec.monitor_interval_s)
+            if self._shutdown.is_set():
+                return 0
             self._flush_telemetry()
             code = self._proc.poll()
             if code is not None:
+                if self._shutdown.is_set():
+                    return 0
                 if code == 0:
                     logger.info("worker finished successfully")
                     return 0
                 obs.get_flight_recorder().record_event(
                     "worker_failed", exit_code=code,
                     restart=self._restart_count)
-                self._client.report_failure(
-                    f"worker exit code {code}",
-                    level=TrainingMsgLevel.PROCESS_ERROR,
-                    restart_count=self._restart_count,
-                )
+                try:
+                    self._client.report_failure(
+                        f"worker exit code {code}",
+                        level=TrainingMsgLevel.PROCESS_ERROR,
+                        restart_count=self._restart_count,
+                    )
+                except Exception:  # master down: the restart path's own
+                    # rendezvous will surface a persistent outage
+                    logger.warning("could not report worker failure "
+                                   "(master unreachable)")
                 if self._restart_count >= spec.max_restarts:
                     logger.error(
                         "worker failed (exit %d) with restart budget "
@@ -295,7 +342,7 @@ class ElasticAgent:
                     "worker failed (exit %d); restarting (%d/%d)",
                     code, self._restart_count + 1, spec.max_restarts,
                 )
-                self._restart_worker(count_against_budget=True)
+                self._restart_worker_resilient(count_against_budget=True)
                 continue
             # Hang flagged by the detector thread: restart HERE so only
             # the main loop ever touches the worker process.
@@ -303,14 +350,20 @@ class ElasticAgent:
                 self._hang_event.clear()
                 logger.error("restarting hanged worker")
                 obs.get_flight_recorder().record_event("worker_hang")
-                self._restart_worker(count_against_budget=False)
+                self._restart_worker_resilient(count_against_budget=False)
                 continue
             # Healthy: restart on membership change so the world re-forms
             # (reference: training.py:483-486,510-521).
             try:
                 waiting = self._client.num_nodes_waiting(self._rdzv_name)
-            except Exception:  # master transiently unreachable
-                waiting = 0
+                self._master_fail_streak = 0
+            except Exception:  # retry budget exhausted this poll
+                self._master_fail_streak += 1
+                if (self._master_fail_streak
+                        >= spec.master_lost_after_polls):
+                    self._master_fail_streak = 0
+                    self._handle_master_loss()
+                continue
             if waiting > 0:
                 logger.info(
                     "%d node(s) waiting: restarting worker to re-form the "
@@ -318,9 +371,127 @@ class ElasticAgent:
                 )
                 obs.get_flight_recorder().record_event(
                     "membership_restart", waiting=waiting)
-                self._restart_worker(count_against_budget=False)
+                self._restart_worker_resilient(count_against_budget=False)
+
+    # -- master failover ---------------------------------------------------
+    def _handle_master_loss(self) -> None:
+        """Degraded "master lost" mode. The worker keeps training — it
+        only needs the master for shards and elasticity — while this
+        loop (1) re-resolves the master address (bootstrap file / env),
+        (2) reconnects with jittered exponential backoff, (3)
+        re-registers through the generation-token handshake, and (4)
+        re-syncs rendezvous state, restarting the worker only when the
+        world actually moved on. Raises MasterLostError once
+        master_reconnect_timeout_s is exhausted."""
+        from dlrover_tpu.agent.master_client import backoff_delay_s
+        from dlrover_tpu.common.config import Context
+
+        ctx = Context.singleton()
+        recorder = obs.get_flight_recorder()
+        logger.error(
+            "master at %s unreachable: entering master-lost mode "
+            "(worker keeps running; reconnect budget %.0fs)",
+            self._client.master_addr, ctx.master_reconnect_timeout_s)
+        recorder.record_event("master_lost",
+                              addr=self._client.master_addr,
+                              rank=self._client.node_rank)
+        obs.get_registry().counter(
+            "dlrover_tpu_master_lost_total",
+            "Master-lost episodes entered by this agent").inc()
+        while True:
+            result = self._reconnect_master(ctx, recorder)
+            try:
+                self._resync_rendezvous(result)
+                return
+            except grpc.RpcError as exc:
+                # the master flapped again mid-resync: back to the
+                # reconnect loop (each successful reconnect earned a
+                # fresh budget — progress was made) rather than dying
+                # on one RPC retry budget. Anything non-transport
+                # (RendezvousTimeoutError, a spawn OSError) propagates —
+                # retrying those against a healthy master loops forever.
+                logger.warning(
+                    "master flapped during rendezvous re-sync (%s); "
+                    "re-entering the reconnect loop", exc)
+
+    def _reconnect_master(self, ctx, recorder):
+        """Dial until one reconnect_report round-trips (or the budget
+        runs out); returns the master's ReconnectResult."""
+        deadline = time.time() + ctx.master_reconnect_timeout_s
+        attempt = 0
+        while True:
+            if self._shutdown.is_set():
+                raise MasterLostError("agent shut down mid-reconnect")
+            addr = self._client.resolve_master_addr(
+                self._client.master_addr)
+            try:
+                with obs.span("reconnect",
+                              {"addr": addr,
+                               "rank": self._client.node_rank,
+                               "attempt": attempt}) as reconnect_span:
+                    self._client.reconnect(addr)
+                    result = self._client.reconnect_report(
+                        local_world_size=self._spec.devices_per_node,
+                        rdzv_name=self._rdzv_name,
+                        rdzv_round=self.last_round,
+                    )
+                    reconnect_span.set_attr("generation",
+                                            result.generation)
+                    reconnect_span.set_attr("world_intact",
+                                            result.world_intact)
+            except Exception as exc:  # noqa: BLE001 — grpc errors vary
+                attempt += 1
+                if time.time() >= deadline:
+                    raise MasterLostError(
+                        f"master unreachable for "
+                        f"{ctx.master_reconnect_timeout_s:.0f}s "
+                        f"(last tried {addr})") from exc
+                delay = backoff_delay_s(attempt, ctx.rpc_backoff_s,
+                                        ctx.rpc_backoff_max_s)
+                logger.warning(
+                    "master still unreachable at %s (attempt %d): %s; "
+                    "next dial in %.1fs", addr, attempt, exc, delay)
+                time.sleep(delay)
+                continue
+            logger.info(
+                "reconnected to master %s (generation %d, world "
+                "intact=%s)", addr, result.generation,
+                result.world_intact)
+            recorder.record_event(
+                "master_reconnected", addr=addr,
+                generation=result.generation,
+                world_intact=result.world_intact)
+            return result
+
+    def _resync_rendezvous(self, result) -> None:
+        """After re-registration: keep the running worker only when the
+        restored master still holds OUR world as its latest; otherwise
+        restart so the world re-forms through a fresh rendezvous."""
+        with obs.span("rendezvous",
+                      {"rdzv": self._rdzv_name,
+                       "rank": self._client.node_rank,
+                       "resync": True}) as resync_span:
+            worker_alive = (self._proc is not None
+                            and self._proc.poll() is None)
+            intact = result.world_intact and worker_alive
+            if intact:
+                try:
+                    _, _, world = self._client.get_comm_world(
+                        self._rdzv_name)
+                    intact = bool(world) and world == self.last_world
+                except Exception:  # noqa: BLE001 — master flapped again
+                    intact = False
+            resync_span.set_attr("world_intact", intact)
+            if intact:
+                logger.info("world %s survived the master outage; "
+                            "worker keeps running", sorted(self.last_world))
+                return
+            logger.info("world changed across the master outage; "
+                        "restarting worker to re-form")
+            self._restart_worker(count_against_budget=False)
 
     def shutdown(self) -> None:
+        self._shutdown.set()
         self._stop_monitors()
         self._stop_worker()
         obs.remove_span_sink(self._span_exporter)

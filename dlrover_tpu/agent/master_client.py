@@ -10,26 +10,54 @@ from __future__ import annotations
 
 import functools
 import os
+import random
 import time
 from typing import Dict, Optional, Tuple
 
 from dlrover_tpu.common import messages as msg
-from dlrover_tpu.common.comm import MasterStub, build_channel, local_ip
+from dlrover_tpu.common.comm import (
+    MasterStub,
+    TransportFaultInjector,
+    build_channel,
+    local_ip,
+)
+from dlrover_tpu.common.config import Context
 from dlrover_tpu.common.constants import NodeEnv, RendezvousName
 
 
-def retry_rpc(retries: int = 10, backoff_s: float = 1.0):
+def backoff_delay_s(attempt: int, base_s: float, cap_s: float) -> float:
+    """Jittered exponential backoff: min(cap, base·2^attempt) scaled by
+    a uniform [0.5, 1.0) jitter so a fleet of agents retrying against a
+    restarted master doesn't stampede it in lockstep."""
+    # exponent clamped: an unbounded attempt counter (a long reconnect
+    # loop) must saturate at the cap, not overflow 2.0**1024
+    envelope = min(cap_s, base_s * (2.0 ** min(attempt, 62)))
+    return envelope * random.uniform(0.5, 1.0)
+
+
+def retry_rpc(retries: Optional[int] = None,
+              backoff_s: Optional[float] = None,
+              max_backoff_s: Optional[float] = None):
+    """Retry decorator. None parameters resolve from Context at CALL
+    time (not import time), so tests — and the agent's master-lost
+    handling — can shrink the budget on a live process."""
+
     def decorator(fn):
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
+            ctx = Context.singleton()
+            attempts = retries if retries is not None else ctx.rpc_retries
+            base = backoff_s if backoff_s is not None else ctx.rpc_backoff_s
+            cap = (max_backoff_s if max_backoff_s is not None
+                   else ctx.rpc_backoff_max_s)
             last_exc = None
-            for attempt in range(retries):
+            for attempt in range(max(1, attempts)):
                 try:
                     return fn(*args, **kwargs)
                 except Exception as exc:  # noqa: BLE001 — grpc errors vary
                     last_exc = exc
-                    if attempt < retries - 1:
-                        time.sleep(backoff_s * min(attempt + 1, 5))
+                    if attempt < attempts - 1:
+                        time.sleep(backoff_delay_s(attempt, base, cap))
             raise last_exc
 
         return wrapped
@@ -41,15 +69,60 @@ class MasterClient:
     _singleton: Optional["MasterClient"] = None
 
     def __init__(self, master_addr: str, node_id: int = 0,
-                 node_rank: Optional[int] = None, timeout_s: float = 30.0,
+                 node_rank: Optional[int] = None,
+                 timeout_s: Optional[float] = None,
                  node_type: str = ""):
         self.master_addr = master_addr
         self.node_id = node_id
         self.node_type = node_type
         self.node_rank = node_rank if node_rank is not None else node_id
-        self._timeout_s = timeout_s
+        # per-call deadline; wait_for_ready means an unreachable master
+        # surfaces as DEADLINE_EXCEEDED after exactly this long
+        self._timeout_s = (timeout_s if timeout_s is not None
+                           else Context.singleton().rpc_timeout_s)
+        # the latest master generation any RPC reported (0 = unknown);
+        # presented on reconnect so a restarted master can tell this
+        # re-registration from a brand-new joiner
+        self.master_generation = 0
+        # owned by the CLIENT, not the stub: a seeded chaos injector
+        # must keep its RNG sequence across reconnect()s, or a seed
+        # whose first draw fires would deterministically kill the first
+        # RPC after every re-dial
+        self._fault_injector = TransportFaultInjector.from_env()
         self._channel = build_channel(master_addr)
-        self._stub = MasterStub(self._channel)
+        self._stub = MasterStub(self._channel,
+                                fault_injector=self._fault_injector)
+
+    def reconnect(self, master_addr: Optional[str] = None) -> None:
+        """Tear down the channel and dial (a possibly different) master.
+        Existing typed wrappers keep working — they go through the new
+        stub on the next call."""
+        addr = master_addr or self.master_addr
+        try:
+            self._channel.close()
+        except Exception:  # noqa: BLE001 — a dead channel may refuse
+            pass
+        self.master_addr = addr
+        self._channel = build_channel(addr)
+        self._stub = MasterStub(self._channel,
+                                fault_injector=self._fault_injector)
+
+    @staticmethod
+    def resolve_master_addr(default: str = "") -> str:
+        """Where is the master NOW? The bootstrap file wins (a restarted
+        master atomically rewrites it with its new address); the env
+        contract is the fallback; then the caller's default."""
+        path = os.getenv(NodeEnv.MASTER_BOOTSTRAP, "") or (
+            Context.singleton().master_bootstrap_file)
+        if path:
+            try:
+                with open(path) as f:
+                    addr = f.read().strip()
+                if addr:
+                    return addr
+            except OSError:
+                pass
+        return os.getenv(NodeEnv.MASTER_ADDR, "") or default
 
     # -- raw --------------------------------------------------------------
     def _get(self, request: msg.Message) -> msg.Message:
@@ -138,7 +211,29 @@ class MasterClient:
             node_ip=local_ip(),
             trace=current_context() or {},
         ), msg.JoinRendezvousResult)
+        if result.generation:
+            self.master_generation = result.generation
         return result.round
+
+    def reconnect_report(self, local_world_size: int = 1,
+                         rdzv_name: str = RendezvousName.TRAINING,
+                         rdzv_round: int = -1) -> msg.ReconnectResult:
+        """Re-register with a (possibly restarted) master after a
+        master-lost episode. Deliberately undecorated: the caller's
+        reconnect loop owns pacing, and a single clean failure per dial
+        attempt keeps that loop's backoff honest."""
+        result = self._report_typed(msg.ReconnectRequest(
+            node_id=self.node_id,
+            node_rank=self.node_rank,
+            node_type=self.node_type,
+            local_world_size=local_world_size,
+            rdzv_name=rdzv_name,
+            generation=self.master_generation,
+            rdzv_round=rdzv_round,
+        ), msg.ReconnectResult)
+        if result.generation:
+            self.master_generation = result.generation
+        return result
 
     @retry_rpc()
     def leave_rendezvous(self, rdzv_name: str = RendezvousName.TRAINING

@@ -149,21 +149,73 @@ class FlashCheckpointer:
 
     def restore(self, abstract_state: Any
                 ) -> Optional[Tuple[Any, Dict[str, Any], int]]:
-        """Restore the latest checkpoint INTO the abstract state's shardings
-        (reshard-on-restore). Returns (state, data_state, step) or None.
+        """Restore the newest restorable checkpoint INTO the abstract
+        state's shardings (reshard-on-restore). Returns
+        (state, data_state, step) or None when no checkpoint exists.
+
+        Fallback chain: a corrupt/partial newest step (an Orbax raise —
+        torn save, preempted commit, bit rot) is logged loudly, counted
+        in ``dlrover_tpu_checkpoint_restore_fallbacks_total``, and the
+        next-older step is tried — the trainer resumes slightly further
+        back instead of crash-looping on poison. Only when EVERY step
+        fails does the last error propagate (silently reinitializing
+        from scratch would throw away the job's progress).
 
         Quantized checkpoints are detected from the data item's marker
         (written by maybe_save), decoded on device into the abstract
         state's dtypes + shardings."""
-        step = self._manager.latest_step()
-        if step is None:
+        steps = sorted(self._manager.all_steps() or (), reverse=True)
+        if not steps:
             return None
-        with obs.span("checkpoint_restore", {"step": step}):
-            result = self._restore_at(step, abstract_state)
-        obs.get_registry().counter(
-            "dlrover_tpu_checkpoint_restores_total",
-            "Checkpoint restores completed").inc()
-        return result
+        first_exc: Optional[Exception] = None
+        failed_steps = []
+        for nth, step in enumerate(steps):
+            try:
+                with obs.span("checkpoint_restore",
+                              {"step": step, "fallback": nth > 0}):
+                    result = self._restore_at(step, abstract_state)
+            except Exception as e:  # noqa: BLE001 — Orbax raise varies
+                # keep the NEWEST step's error for the final raise: when
+                # every step fails the same systematic way (e.g. a
+                # restore-target shape mismatch), that's the one the
+                # operator needs, not the oldest retained step's
+                first_exc = first_exc if first_exc is not None else e
+                failed_steps.append(step)
+                logger.error(
+                    "checkpoint restore at step %d FAILED (%s: %s); "
+                    "falling back to the next-older step", step,
+                    type(e).__name__, e)
+                obs.get_registry().counter(
+                    "dlrover_tpu_checkpoint_restore_fallbacks_total",
+                    "Corrupt/partial checkpoints skipped during "
+                    "restore").inc()
+                continue
+            if failed_steps:
+                self._remove_failed_steps(failed_steps)
+            obs.get_registry().counter(
+                "dlrover_tpu_checkpoint_restores_total",
+                "Checkpoint restores completed").inc()
+            return result
+        raise first_exc
+
+    def _remove_failed_steps(self, steps) -> None:
+        """Drop the corrupt newer steps a fallback skipped: the resumed
+        trainer re-reaches those step numbers and Orbax refuses to save
+        into an existing step directory — leaving the poison in place
+        would re-crash the very job the fallback just rescued."""
+        import os
+        import shutil
+
+        for step in steps:
+            try:
+                self._manager.delete(step)
+            except Exception:  # noqa: BLE001 — metadata may be torn too
+                shutil.rmtree(os.path.join(str(self._directory),
+                                           str(step)),
+                              ignore_errors=True)
+            logger.warning(
+                "checkpoint: removed unrestorable step %d (resumed "
+                "training will rewrite it)", step)
 
     def _restore_at(self, step: int, abstract_state: Any
                     ) -> Tuple[Any, Dict[str, Any], int]:

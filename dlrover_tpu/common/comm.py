@@ -9,10 +9,14 @@ dataclasses of dlrover_tpu.common.messages.
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import os
+import random
 import socket
+import time
 from concurrent import futures
-from typing import Callable, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import grpc
 
@@ -21,6 +25,14 @@ from dlrover_tpu.common.constants import DefaultValues
 SERVICE_NAME = "dlrovertpu.Master"
 GET_METHOD = f"/{SERVICE_NAME}/get"
 REPORT_METHOD = f"/{SERVICE_NAME}/report"
+
+# Transport-level fault injection (diagnostics/chaos.py is the step-level
+# twin): "drop:0.2;delay:0.5;error:0.05" makes every client RPC drop with
+# p=0.2 (raises UNAVAILABLE before the wire), sleep 0.5 s, or fail with
+# p=0.05 (INTERNAL) — so retry/reconnect/recovery paths can be exercised
+# deterministically (seed via DLROVER_TPU_CHAOS_NET_SEED).
+CHAOS_NET_ENV = "DLROVER_TPU_CHAOS_NET"
+CHAOS_NET_SEED_ENV = "DLROVER_TPU_CHAOS_NET_SEED"
 
 _MAX_MESSAGE_BYTES = DefaultValues.GRPC_MAX_MESSAGE_MB * 1024 * 1024
 
@@ -62,10 +74,109 @@ def addr_connectable(addr: str, timeout_s: float = 2.0) -> bool:
         return False
 
 
+class InjectedRpcError(grpc.RpcError):
+    """A client-side fault minted by the transport chaos layer. Shaped
+    like a real grpc.RpcError (code()/details()) so retry and error
+    classification paths cannot tell it from the genuine article."""
+
+    def __init__(self, code: grpc.StatusCode, details: str):
+        super().__init__()
+        self._code = code
+        self._details = details
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+    def __str__(self) -> str:
+        return f"InjectedRpcError({self._code}, {self._details!r})"
+
+
+@dataclasses.dataclass
+class NetFaultSpec:
+    drop: float = 0.0       # P(raise UNAVAILABLE before the wire)
+    delay_s: float = 0.0    # added latency when the delay fault fires
+    delay_p: float = 1.0    # P(delay fires) when delay_s > 0
+    error: float = 0.0      # P(raise INTERNAL before the wire)
+
+
+def parse_net_chaos(spec: str) -> NetFaultSpec:
+    """Parse the CHAOS_NET grammar ("drop:P;delay:S[:P];error:P");
+    raises ValueError on a bad spec — a chaos run with a typo'd fault
+    must fail loudly, not run clean."""
+    result = NetFaultSpec()
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        fields = part.split(":")
+        kind = fields[0].strip().lower()
+        try:
+            if kind == "drop" and len(fields) == 2:
+                result.drop = float(fields[1])
+            elif kind == "delay" and len(fields) in (2, 3):
+                result.delay_s = float(fields[1])
+                if len(fields) == 3:
+                    result.delay_p = float(fields[2])
+            elif kind == "error" and len(fields) == 2:
+                result.error = float(fields[1])
+            else:
+                raise ValueError(f"unknown net fault {kind!r}")
+        except ValueError as e:
+            raise ValueError(
+                f"bad net chaos fault {part!r} (want "
+                f"'drop:P', 'delay:S[:P]' or 'error:P'): {e}") from e
+    for name, prob in (("drop", result.drop), ("delay", result.delay_p),
+                       ("error", result.error)):
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(
+                f"net chaos {name} probability {prob} outside [0, 1]")
+    if result.delay_s < 0:
+        raise ValueError(f"net chaos delay {result.delay_s} is negative")
+    return result
+
+
+class TransportFaultInjector:
+    """Applies a NetFaultSpec before each client RPC. One instance per
+    stub; faults are decided by a private seeded RNG so a chaos run is
+    reproducible. Injecting client-side (before gRPC's own channel
+    retry policy can see the call) exercises OUR retry layer."""
+
+    def __init__(self, spec: str, seed: Optional[int] = None):
+        self._spec = parse_net_chaos(spec)
+        self._rng = random.Random(seed)
+        self.injected: Dict[str, int] = {"drop": 0, "delay": 0,
+                                         "error": 0}
+
+    @classmethod
+    def from_env(cls) -> Optional["TransportFaultInjector"]:
+        spec = os.environ.get(CHAOS_NET_ENV, "")
+        if not spec:
+            return None
+        seed_raw = os.environ.get(CHAOS_NET_SEED_ENV, "")
+        return cls(spec, seed=int(seed_raw) if seed_raw else None)
+
+    def before_rpc(self, method: str) -> None:
+        spec = self._spec
+        if spec.delay_s > 0 and self._rng.random() < spec.delay_p:
+            self.injected["delay"] += 1
+            time.sleep(spec.delay_s)
+        if spec.drop > 0 and self._rng.random() < spec.drop:
+            self.injected["drop"] += 1
+            raise InjectedRpcError(
+                grpc.StatusCode.UNAVAILABLE,
+                f"chaos-net dropped {method}")
+        if spec.error > 0 and self._rng.random() < spec.error:
+            self.injected["error"] += 1
+            raise InjectedRpcError(
+                grpc.StatusCode.INTERNAL,
+                f"chaos-net errored {method}")
+
+
 class MasterStub:
     """Client-side stub over the generic channel."""
 
-    def __init__(self, channel: grpc.Channel):
+    def __init__(self, channel: grpc.Channel,
+                 fault_injector: Optional[TransportFaultInjector] = None):
         self._get = channel.unary_unary(
             GET_METHOD, request_serializer=_identity,
             response_deserializer=_identity,
@@ -74,12 +185,21 @@ class MasterStub:
             REPORT_METHOD, request_serializer=_identity,
             response_deserializer=_identity,
         )
+        # env-armed unless an explicit injector was handed in (tests);
+        # None when CHAOS_NET is unset — zero cost on the happy path
+        self._fault_injector = (fault_injector
+                                if fault_injector is not None
+                                else TransportFaultInjector.from_env())
 
     def get(self, payload: bytes, timeout: Optional[float] = None) -> bytes:
+        if self._fault_injector is not None:
+            self._fault_injector.before_rpc("get")
         return self._get(payload, timeout=timeout, wait_for_ready=True)
 
     def report(self, payload: bytes,
                timeout: Optional[float] = None) -> bytes:
+        if self._fault_injector is not None:
+            self._fault_injector.before_rpc("report")
         return self._report(payload, timeout=timeout, wait_for_ready=True)
 
 

@@ -31,6 +31,27 @@ class Context:
         )
         self.max_relaunch: int = DefaultValues.MAX_RELAUNCH
         self.kv_wait_timeout_s: float = DefaultValues.KV_WAIT_TIMEOUT_S
+        # client RPC budget (agent/master_client.py): per-call deadline,
+        # attempt count, and the jittered-exponential-backoff envelope —
+        # tests shrink these so failure paths run in milliseconds
+        self.rpc_timeout_s: float = DefaultValues.RPC_TIMEOUT_S
+        self.rpc_retries: int = DefaultValues.RPC_RETRIES
+        self.rpc_backoff_s: float = DefaultValues.RPC_BACKOFF_S
+        self.rpc_backoff_max_s: float = DefaultValues.RPC_BACKOFF_MAX_S
+        self.master_reconnect_timeout_s: float = (
+            DefaultValues.MASTER_RECONNECT_TIMEOUT_S
+        )
+        # crash-consistent master state: snapshots land here ("" = state
+        # persistence disabled); the bootstrap file carries the master's
+        # advertised address across restarts ("" = env-only resolution)
+        self.master_state_dir: str = ""
+        self.master_bootstrap_file: str = ""
+        self.master_snapshot_retain: int = (
+            DefaultValues.MASTER_SNAPSHOT_RETAIN
+        )
+        self.master_snapshot_min_interval_s: float = (
+            DefaultValues.MASTER_SNAPSHOT_MIN_INTERVAL_S
+        )
         self.monitor_interval_s: float = DefaultValues.MONITOR_INTERVAL_S
         self.report_resource_interval_s: float = (
             DefaultValues.REPORT_RESOURCE_INTERVAL_S

@@ -83,6 +83,10 @@ class NodeEnv:
     NodeEnv.DLROVER_MASTER_ADDR)."""
 
     MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    # File a (re)started master atomically writes its advertised address
+    # into; agents in master-lost mode re-resolve from it (the address of
+    # a restarted master usually differs — new pod IP / new free port).
+    MASTER_BOOTSTRAP = "DLROVER_TPU_MASTER_BOOTSTRAP_FILE"
     NODE_ID = "DLROVER_TPU_NODE_ID"
     NODE_TYPE = "DLROVER_TPU_NODE_TYPE"
     NODE_RANK = "DLROVER_TPU_NODE_RANK"
@@ -154,6 +158,23 @@ class DefaultValues:
     DEAD_NODE_TIMEOUT_S = 90.0
     MAX_RELAUNCH = 3
     GRPC_MAX_MESSAGE_MB = 64
+    # client-side RPC budget: jittered exponential backoff between
+    # attempts, capped (agent/master_client.py retry_rpc)
+    RPC_TIMEOUT_S = 30.0
+    RPC_RETRIES = 10
+    RPC_BACKOFF_S = 0.5
+    RPC_BACKOFF_MAX_S = 15.0
+    # master-loss handling (agent/elastic_agent.py): how long an agent
+    # keeps its workers alive while reconnecting to a restarted master
+    MASTER_RECONNECT_TIMEOUT_S = 1800.0
+    # crash-consistent master state (master/state_backend.py)
+    MASTER_SNAPSHOT_RETAIN = 5
+    # 0 = write-through (a snapshot per control-plane mutation: strict
+    # no-loss/no-double-assign recovery). > 0 coalesces snapshots to at
+    # most one per interval — bounds write amplification on
+    # dispatch-heavy phases at the cost of up to that much durability
+    # lag on a crash (docs/fault_tolerance.md)
+    MASTER_SNAPSHOT_MIN_INTERVAL_S = 0.0
     KV_WAIT_TIMEOUT_S = 300.0
     MONITOR_INTERVAL_S = 5.0
     REPORT_RESOURCE_INTERVAL_S = 15.0

@@ -185,6 +185,37 @@ class JoinRendezvousResult(Message):
     # get_comm_world advance past this round without including it (world
     # invalidated by a member death, or dropped by node_unit rounding).
     round: int = 0
+    # Master generation token (bumped each master (re)start over one state
+    # lineage): agents remember it and present it on reconnect so a
+    # restarted master can tell re-registration from a new joiner.
+    generation: int = 0
+
+
+@dataclass
+class ReconnectRequest(Message):
+    """An agent in master-lost mode re-registering with a (possibly
+    restarted) master. Carries everything the master needs to decide
+    whether the agent's cached world is still valid."""
+
+    node_id: int = -1
+    node_rank: int = -1
+    node_type: str = ""
+    local_world_size: int = 1
+    rdzv_name: str = ""
+    # the generation the agent last saw (0 = it never learned one)
+    generation: int = 0
+    # the last completed round the agent was placed in (-1 = none)
+    rdzv_round: int = -1
+
+
+@dataclass
+class ReconnectResult(Message):
+    generation: int = 0
+    # True: the agent's rank is in the master's latest world for the
+    # round the agent reported — keep the worker running. False: the
+    # world moved on (or was never restored); re-join rendezvous.
+    world_intact: bool = False
+    round: int = -1
 
 
 @dataclass

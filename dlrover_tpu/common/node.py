@@ -129,6 +129,52 @@ class Node:
         node.relaunch_count = self.relaunch_count + 1
         return node
 
+    # -- crash-consistent state (master/state_backend.py) ------------------
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "id": self.id,
+            "rank_index": self.rank_index,
+            "name": self.name,
+            "status": self.status,
+            "config_resource": self.config_resource.to_dict(),
+            "critical": self.critical,
+            "relaunchable": self.relaunchable,
+            "max_relaunch_count": self.max_relaunch_count,
+            "relaunch_count": self.relaunch_count,
+            "exit_reason": self.exit_reason,
+            "host_addr": self.host_addr,
+            "host_port": self.host_port,
+            "create_time": self.create_time,
+            "start_time": self.start_time,
+            "finish_time": self.finish_time,
+            "is_released": self.is_released,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Node":
+        node = cls(
+            d["type"],
+            int(d["id"]),
+            rank_index=int(d.get("rank_index", d["id"])),
+            name=d.get("name", ""),
+            status=d.get("status", NodeStatus.INITIAL),
+            config_resource=NodeResource.from_dict(
+                d.get("config_resource")),
+            critical=bool(d.get("critical", False)),
+            max_relaunch_count=int(d.get("max_relaunch_count", 3)),
+            relaunchable=bool(d.get("relaunchable", True)),
+        )
+        node.relaunch_count = int(d.get("relaunch_count", 0))
+        node.exit_reason = d.get("exit_reason", "")
+        node.host_addr = d.get("host_addr", "")
+        node.host_port = int(d.get("host_port", 0))
+        node.create_time = d.get("create_time")
+        node.start_time = d.get("start_time")
+        node.finish_time = d.get("finish_time")
+        node.is_released = bool(d.get("is_released", False))
+        return node
+
     def __repr__(self):
         return (f"Node({self.type}-{self.id} rank={self.rank_index} "
                 f"status={self.status})")

@@ -18,10 +18,23 @@ Spec grammar (semicolon-separated faults):
     slow:worker:2@4:0.5    rank 2 sleeps 0.5 s EVERY step from step 4 on
                            (a straggler the network-check/speed paths
                            should flag)
+    kill:master:0@5        SIGKILL the job MASTER when any worker reports
+                           step 5 (the servicer feeds worker
+                           GlobalStepReports to a master-side injector) —
+                           exercises crash-consistent state recovery +
+                           agent reconnection (docs/fault_tolerance.md)
 
 Each kill/hang fault fires at most once per process; slow applies from
 its step onward. The hook is a no-op (one env read at construction)
 when the variable is unset — zero cost on the training path.
+
+One-shot markers (CHAOS_STATE_ENV) are keyed by the fault's INDEX in
+the full spec (not just action/role/rank/step), so duplicate faults
+fire independently, and are created atomically (O_EXCL) so two racing
+incarnations cannot both claim an unfired fault.
+
+The transport-level twin — probabilistic RPC drop/delay/error via
+DLROVER_TPU_CHAOS_NET — lives in common/comm.py.
 """
 
 from __future__ import annotations
@@ -45,18 +58,24 @@ CHAOS_STATE_ENV = "DLROVER_TPU_CHAOS_STATE"
 @dataclasses.dataclass
 class ChaosFault:
     action: str            # "kill" | "hang" | "slow"
-    role: str              # node type the fault targets ("worker", …)
+    role: str              # node type the fault targets ("worker",
+    #                        "master", …)
     rank: int              # node rank within the role
     at_step: int           # fire when the target reaches this step
     duration: float = 60.0  # hang: block seconds; slow: sleep/step
     fired: bool = False
+    # position in the FULL spec (before role/rank filtering): the
+    # one-shot marker key, stable across respawns that re-parse the
+    # same env — and distinct for duplicate faults
+    index: int = 0
 
 
 def parse_chaos(spec: str) -> List[ChaosFault]:
     """Parse the CHAOS_ENV grammar; raises ValueError on a bad spec (a
     chaos run with a typo'd fault must fail loudly, not run clean)."""
     faults = []
-    for part in filter(None, (p.strip() for p in spec.split(";"))):
+    for index, part in enumerate(
+            filter(None, (p.strip() for p in spec.split(";")))):
         try:
             head, at = part.split("@", 1)
             action, role, rank = head.split(":")
@@ -64,6 +83,7 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
             fault = ChaosFault(
                 action=action.strip().lower(), role=role.strip(),
                 rank=int(rank), at_step=int(at_fields[0]),
+                index=index,
             )
             if len(at_fields) > 1:
                 fault.duration = float(at_fields[1])
@@ -73,6 +93,10 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
                 f"'action:role:rank@step[:duration]'): {e}") from e
         if fault.action not in ("kill", "hang", "slow"):
             raise ValueError(f"unknown chaos action {fault.action!r}")
+        if fault.rank < 0:
+            raise ValueError(
+                f"chaos fault {part!r} has negative rank {fault.rank} "
+                f"(no node can match it)")
         faults.append(fault)
     return faults
 
@@ -103,39 +127,54 @@ class ChaosInjector:
                            role, rank, self.faults)
 
     def _marker(self, fault: ChaosFault) -> str:
+        # keyed by spec index: two faults that agree on
+        # action/role/rank/step still get their own markers
         return os.path.join(
             self._state_dir,
-            f"chaos_{fault.action}_{fault.role}_{fault.rank}"
-            f"_{fault.at_step}")
+            f"chaos_{fault.index}_{fault.action}_{fault.role}"
+            f"_{fault.rank}_{fault.at_step}")
 
     def _already_fired(self, fault: ChaosFault) -> bool:
         return bool(self._state_dir) and os.path.exists(
             self._marker(fault))
 
-    def _record_fired(self, fault: ChaosFault) -> None:
+    def _record_fired(self, fault: ChaosFault) -> bool:
+        """Claim the one-shot marker; returns whether THIS process won.
+        O_CREAT|O_EXCL is the atomicity: a racing incarnation loses the
+        create and must not fire the fault a second time."""
         fault.fired = True
-        if self._state_dir:
-            os.makedirs(self._state_dir, exist_ok=True)
-            with open(self._marker(fault), "w") as f:
-                f.write(str(os.getpid()))
+        if not self._state_dir:
+            return True
+        os.makedirs(self._state_dir, exist_ok=True)
+        try:
+            fd = os.open(self._marker(fault),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            f.write(str(os.getpid()))
+        return True
 
     def maybe_inject(self, step: int) -> None:
         for fault in self.faults:
             if fault.fired or step < fault.at_step:
                 continue
             if fault.action == "kill":
-                logger.warning("chaos: SIGKILL self (%s-%d) at step %d",
-                               self._role, self._rank, step)
                 # record BEFORE dying, or the respawned incarnation
                 # replays the fault forever
-                self._record_fired(fault)
+                if not self._record_fired(fault):
+                    continue
+                logger.warning("chaos: SIGKILL self (%s-%d) at step %d",
+                               self._role, self._rank, step)
                 os.kill(os.getpid(), signal.SIGKILL)
             elif fault.action == "hang":
-                self._record_fired(fault)
                 logger.warning("chaos: hanging %s-%d for %.1fs at step %d",
                                self._role, self._rank, fault.duration,
                                step)
                 time.sleep(fault.duration)
+                # record AFTER the sleep: a process killed and respawned
+                # mid-hang must replay the hang, not skip it
+                self._record_fired(fault)
             elif fault.action == "slow":
                 # applies every step from at_step on (a real straggler)
                 time.sleep(fault.duration)

@@ -9,14 +9,18 @@ hang; the node manager (when attached) owns node lifecycle.
 
 from __future__ import annotations
 
+import os
 import threading
+import time
+from typing import Optional
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.comm import build_server
 from dlrover_tpu.common.config import Context
-from dlrover_tpu.common.constants import JobStage, RendezvousName
+from dlrover_tpu.common.constants import JobStage, NodeType, RendezvousName
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.state_backend import MasterStateBackend
 from dlrover_tpu.master.rendezvous import (
     ElasticTrainingRendezvousManager,
     NetworkCheckRendezvousManager,
@@ -43,6 +47,7 @@ class JobMaster:
         cluster=None,
         host: str = "0.0.0.0",
         brain_addr: str = "",
+        state_dir: Optional[str] = None,
     ):
         ctx = Context.singleton()
         params = RendezvousParameters(
@@ -108,6 +113,127 @@ class JobMaster:
             self.job_manager = manager
             self.servicer.job_manager = manager
             self._attach_optimization(job_args, brain_addr)
+        self._init_state_backend(
+            state_dir if state_dir is not None else ctx.master_state_dir,
+            ctx.master_snapshot_retain,
+        )
+        self._arm_master_chaos()
+
+    # -- crash-consistent control-plane state --------------------------
+    def _init_state_backend(self, state_dir: str, retain: int) -> None:
+        """Attach the snapshot store and, when a prior master left valid
+        state behind, rebuild every manager from it BEFORE serving. The
+        generation token bumps once per (re)start over one state lineage
+        so reconnecting agents can tell a restarted master from a
+        transient outage."""
+        self._snapshot_lock = threading.Lock()
+        self._state_backend = None
+        self._last_snapshot_ts = 0.0
+        with self._snapshot_lock:
+            self._snapshot_timer: Optional[threading.Timer] = None
+        self.generation = 0
+        if state_dir:
+            self._state_backend = MasterStateBackend(state_dir,
+                                                     retain=retain)
+            self.generation = 1
+            loaded = self._state_backend.load_latest()
+            if loaded is not None:
+                state, version = loaded
+                with obs.span("master_restore",
+                              {"snapshot_version": version}):
+                    self._restore_state(state)
+                logger.info(
+                    "master state restored from snapshot v%d "
+                    "(generation %d)", version, self.generation)
+                obs.get_flight_recorder().record_event(
+                    "master_restore", snapshot_version=version,
+                    generation=self.generation)
+                obs.get_registry().counter(
+                    "dlrover_tpu_master_restores_total",
+                    "Masters rebuilt from a state snapshot").inc()
+            self.servicer.state_sink = self._maybe_snapshot
+            # the generation bump itself must be durable before the
+            # first RPC is served
+            self._maybe_snapshot()
+        self.servicer.generation = self.generation
+
+    def _export_state(self) -> dict:
+        state = {
+            "generation": self.generation,
+            "rendezvous": {name: mgr.export_state()
+                           for name, mgr in self.rdzv_managers.items()},
+            "task_manager": self.task_manager.export_state(),
+            "kv_store": self.kv_store.export_state(),
+            "speed_monitor": self.speed_monitor.export_state(),
+        }
+        if self.job_manager is not None and \
+                hasattr(self.job_manager, "export_state"):
+            state["job_manager"] = self.job_manager.export_state()
+        return state
+
+    def _restore_state(self, state: dict) -> None:
+        self.generation = int(state.get("generation", 0)) + 1
+        for name, rdzv_state in state.get("rendezvous", {}).items():
+            mgr = self.rdzv_managers.get(name)
+            if mgr is not None:
+                mgr.restore_state(rdzv_state)
+        self.task_manager.restore_state(state.get("task_manager", {}))
+        self.kv_store.restore_state(state.get("kv_store", {}))
+        self.speed_monitor.restore_state(state.get("speed_monitor", {}))
+        if self.job_manager is not None and "job_manager" in state and \
+                hasattr(self.job_manager, "restore_state"):
+            self.job_manager.restore_state(state["job_manager"])
+
+    def _maybe_snapshot(self, force: bool = False) -> None:
+        """Persist the control-plane state if it changed (the servicer's
+        post-mutation hook). Serialized: concurrent RPC handlers must
+        not interleave exports with version assignment.
+
+        master_snapshot_min_interval_s > 0 coalesces bursts (e.g. a
+        worker fleet draining a many-shard dataset would otherwise pay
+        one full export+fsync per dispatch): at most one snapshot per
+        interval, trading up to that much durability lag on a crash.
+        A skipped mutation arms a trailing timer so the lag is bounded
+        by the interval even when no later mutation ever arrives (the
+        last TaskResult of a dataset must not stay doing-only forever).
+        The default (0) is strict write-through."""
+        if self._state_backend is None:
+            return
+        interval = Context.singleton().master_snapshot_min_interval_s
+        with self._snapshot_lock:
+            remaining = self._last_snapshot_ts + interval - time.time()
+            if not force and interval > 0 and remaining > 0:
+                if self._snapshot_timer is None:
+                    timer = threading.Timer(remaining,
+                                            self._trailing_snapshot)
+                    timer.daemon = True
+                    self._snapshot_timer = timer
+                    timer.start()
+                return
+            try:
+                written = self._state_backend.save_if_changed(
+                    self._export_state())
+            except Exception:  # noqa: BLE001 — durability is best-effort
+                logger.exception("master state snapshot failed")
+                return
+            if written is not None:
+                self._last_snapshot_ts = time.time()
+
+    def _trailing_snapshot(self) -> None:
+        """Timer body: flush the mutation that fell inside the
+        coalescing window."""
+        with self._snapshot_lock:
+            self._snapshot_timer = None
+        self._maybe_snapshot(force=True)
+
+    def _arm_master_chaos(self) -> None:
+        """kill:master:0@step — fed from worker GlobalStepReports so a
+        chaos run can assassinate the control plane at a chosen step."""
+        from dlrover_tpu.diagnostics.chaos import ChaosInjector
+
+        chaos = ChaosInjector(role=NodeType.MASTER, rank=0)
+        if chaos.faults:
+            self.servicer.master_chaos = chaos
 
     def _attach_optimization(self, job_args, brain_addr: str) -> None:
         """Wire stats collection + resource optimization + auto-scaling
@@ -172,9 +298,31 @@ class JobMaster:
             self.auto_scaler.start()
         self.task_manager.start_timeout_recovery()
         self._start_metrics_exporter()
+        self._publish_bootstrap_addr()
         # an unhandled master crash still leaves the job timeline on disk
         obs.get_flight_recorder().install_excepthook()
         logger.info("job master serving on port %d", self.port)
+
+    def _publish_bootstrap_addr(self) -> None:
+        """Atomically write the advertised address to the bootstrap file
+        so agents in master-lost mode can re-resolve a restarted master
+        (whose port/IP usually changed)."""
+        path = Context.singleton().master_bootstrap_file
+        if not path:
+            return
+        try:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                f.write(self.addr)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("cannot publish master address to %s: %s",
+                           path, e)
+            return
+        logger.info("master address %s published to %s", self.addr, path)
 
     def _start_metrics_exporter(self) -> None:
         """Serve the Prometheus exposition (metrics_port: 0 = any free
@@ -242,6 +390,13 @@ class JobMaster:
             if self._metrics_server is not None:
                 self._metrics_server.shutdown()
                 self._metrics_server.server_close()  # release the socket
+            with self._snapshot_lock:
+                if self._snapshot_timer is not None:
+                    self._snapshot_timer.cancel()
+                    self._snapshot_timer = None
+            # a coalesced mutation must not die with the process when
+            # the stop is graceful
+            self._maybe_snapshot(force=True)
             # the master's half of the postmortem timeline
             obs.get_flight_recorder().record_event(
                 "master_stop", exit_reason=self._exit_reason)
@@ -286,8 +441,21 @@ def run_master_main(args=None) -> int:
                         default=Context.singleton().metrics_port,
                         help="Prometheus /metrics port (0 = any free "
                              "port, -1 = disabled)")
+    parser.add_argument("--state-dir",
+                        default=Context.singleton().master_state_dir,
+                        help="directory for crash-consistent control-"
+                             "plane snapshots; a restarted master "
+                             "recovers from the latest valid one "
+                             "('' = disabled)")
+    parser.add_argument("--bootstrap-file",
+                        default=Context.singleton().master_bootstrap_file,
+                        help="file the master atomically writes its "
+                             "advertised address into; agents re-resolve "
+                             "from it after a master restart")
     ns = parser.parse_args(args)
-    Context.singleton().update(metrics_port=ns.metrics_port)
+    Context.singleton().update(metrics_port=ns.metrics_port,
+                               master_state_dir=ns.state_dir,
+                               master_bootstrap_file=ns.bootstrap_file)
     if ns.platform == "k8s":
         from dlrover_tpu.operator.crd import (
             ELASTICJOB_PLURAL,

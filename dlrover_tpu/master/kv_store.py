@@ -13,6 +13,7 @@ so the client needs one RPC per ~20 s window instead of one per poll tick.
 
 from __future__ import annotations
 
+import base64
 import threading
 import time
 from typing import Dict, List
@@ -68,3 +69,18 @@ class KVStoreService:
     def num_keys(self) -> int:
         with self._cond:
             return len(self._store)
+
+    # -- crash-consistent state (master/state_backend.py) ------------------
+    def export_state(self) -> dict:
+        """Values are bytes: base64 keeps the snapshot JSON-safe."""
+        with self._cond:
+            return {k: base64.b64encode(v).decode("ascii")
+                    for k, v in self._store.items()}
+
+    def restore_state(self, state: dict) -> None:
+        with self._cond:
+            self._store = {k: base64.b64decode(v)
+                           for k, v in state.items()}
+            # restored keys may satisfy a blocked wait (coordinator
+            # bootstrap keys survive the master restart)
+            self._cond.notify_all()

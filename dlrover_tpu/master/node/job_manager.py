@@ -70,9 +70,13 @@ class JobManager:
 
     def _init_nodes(self) -> None:
         """Materialize the Node table from JobArgs (reference:
-        _init_nodes, dist_job_manager.py:262-292)."""
+        _init_nodes, dist_job_manager.py:262-292). Node groups already
+        populated by a state-backend restore keep their restored table —
+        re-materializing would zero every relaunch budget."""
         with self._lock:
             for node_type, args in self._job_args.node_args.items():
+                if self._nodes.get(node_type):
+                    continue
                 group = args.group_resource
                 self._nodes[node_type] = {}
                 for node_id in range(group.count):
@@ -352,6 +356,33 @@ class JobManager:
 
     def collect_model_info(self, info: msg.ModelInfo) -> None:
         self._model_info = info
+
+    # -- crash-consistent state (master/state_backend.py) ---------------
+    def export_state(self) -> dict:
+        with self._lock:
+            return {
+                "stage": self._stage,
+                "exit_reason": self._exit_reason,
+                "nodes": {
+                    node_type: {str(nid): node.to_dict()
+                                for nid, node in by_id.items()}
+                    for node_type, by_id in self._nodes.items()
+                },
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Rebuild the node table (incl. restart budgets) and job stage.
+        Called before start(): _init_nodes then leaves restored groups
+        alone, and the watcher re-adopts any node that changed while the
+        master was down through the normal event path."""
+        with self._lock:
+            self._stage = state.get("stage", self._stage)
+            self._exit_reason = state.get("exit_reason", "")
+            for node_type, by_id in state.get("nodes", {}).items():
+                self._nodes[node_type] = {
+                    int(nid): Node.from_dict(d)
+                    for nid, d in by_id.items()
+                }
 
     # -- hang detection -------------------------------------------------
     def all_running_node_hanged(self) -> bool:

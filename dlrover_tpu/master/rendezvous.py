@@ -69,6 +69,11 @@ class RendezvousManager:
         # torch rendezvous backend expiring silent members,
         # elastic_agent/torch/training.py:483-521)
         self._last_seen: Dict[int, float] = {}
+        # bumped on every mutation of EXPORTED state (joins, leaves,
+        # round cuts, membership changes — NOT liveness touches): lets
+        # the servicer skip the full state export+hash on the
+        # steady-state polls, which mutate nothing almost always
+        self._mutations = 0
 
     # -- membership (driven by the node manager / event callbacks) --------
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
@@ -79,10 +84,16 @@ class RendezvousManager:
                 min_nodes, max_nodes, wait_new_node_s, node_unit
             )
 
+    @property
+    def mutation_count(self) -> int:
+        with self._lock:
+            return self._mutations
+
     def add_alive_node(self, node_rank: int) -> None:
         with self._lock:
             self._alive_nodes.add(node_rank)
             self._last_seen[node_rank] = time.time()
+            self._mutations += 1
 
     def touch(self, node_rank: int) -> None:
         """Record liveness for a rank (any agent RPC qualifies)."""
@@ -118,6 +129,7 @@ class RendezvousManager:
             self._alive_nodes.discard(node_rank)
             self._waiting.pop(node_rank, None)
             self._pending_rejoin.discard(node_rank)
+            self._mutations += 1
             if not graceful and node_rank in self._latest_world:
                 # A member of the cut round died: any survivor handed this
                 # world would only find out at jax.distributed.initialize
@@ -163,6 +175,7 @@ class RendezvousManager:
                 self._node_ips[node_rank] = node_ip
             if len(self._waiting) == 1:
                 self._latest_round_start = time.time()
+            self._mutations += 1
             joined_round = self._rdzv_round
         obs.get_registry().counter(
             "dlrover_tpu_rendezvous_joins_total",
@@ -178,6 +191,7 @@ class RendezvousManager:
         alive (it may re-join); a no-op after the round cut."""
         with self._lock:
             if self._waiting.pop(node_rank, None) is not None:
+                self._mutations += 1
                 logger.info(
                     "%s rendezvous: node %d left the waiting list "
                     "(gave up on the forming round)", self.name,
@@ -258,6 +272,7 @@ class RendezvousManager:
         for rank in chosen:
             del self._waiting[rank]
         self._rdzv_round += 1
+        self._mutations += 1
         logger.info(
             "%s rendezvous round %d completed: world=%s",
             self.name, self._rdzv_round - 1, sorted(self._latest_world),
@@ -300,6 +315,61 @@ class RendezvousManager:
     def rdzv_round(self) -> int:
         with self._lock:
             return self._rdzv_round
+
+    # -- crash-consistent state (master/state_backend.py) ------------------
+    def export_state(self) -> dict:
+        """JSON-safe snapshot of the rendezvous protocol state. Liveness
+        clocks (_last_seen) are NOT exported: wall time on the restarted
+        master restarts them, and exporting stale clocks would reap every
+        member the instant the new master serves its first poll."""
+        with self._lock:
+            state = {
+                "round": self._rdzv_round,
+                "latest_world": {str(r): n
+                                 for r, n in self._latest_world.items()},
+                "waiting": {str(r): w.local_world_size
+                            for r, w in self._waiting.items()},
+                "alive": sorted(self._alive_nodes),
+                "pending_rejoin": sorted(self._pending_rejoin),
+                "node_ips": {str(r): ip
+                             for r, ip in self._node_ips.items()},
+            }
+            # subclass fields join the SAME cut: one lock acquisition,
+            # never two cuts with a mutation in between
+            self._export_extra(state)
+            return state
+
+    def _export_extra(self, state: dict) -> None:
+        """Subclass hook appending extra exported fields (lock held)."""
+
+    def restore_state(self, state: dict) -> None:
+        now = time.time()
+        with self._lock:
+            self._rdzv_round = int(state.get("round", 0))
+            self._latest_world = {
+                int(r): int(n)
+                for r, n in state.get("latest_world", {}).items()
+            }
+            self._waiting = {
+                int(r): _WaitingNode(int(r), int(n), join_time=now)
+                for r, n in state.get("waiting", {}).items()
+            }
+            self._alive_nodes = {int(r) for r in state.get("alive", ())}
+            self._pending_rejoin = {
+                int(r) for r in state.get("pending_rejoin", ())
+            }
+            self._node_ips = {int(r): ip
+                              for r, ip in state.get("node_ips",
+                                                     {}).items()}
+            # every restored member gets a fresh liveness clock: agents
+            # re-register within their poll interval, the genuinely dead
+            # age out through the normal reap path
+            self._last_seen = {rank: now for rank in self._alive_nodes}
+            self._latest_round_start = now
+            self._restore_extra(state)
+
+    def _restore_extra(self, state: dict) -> None:
+        """Subclass hook restoring extra exported fields (lock held)."""
 
 
 class ElasticTrainingRendezvousManager(RendezvousManager):
@@ -431,3 +501,29 @@ class NetworkCheckRendezvousManager(RendezvousManager):
     def network_check_success(self) -> bool:
         fault, rounds = self.check_fault_node()
         return rounds > 0 and not fault
+
+    def _export_extra(self, state: dict) -> None:
+        """Check-cycle fields join the base export's cut (lock held)."""
+        state["check_round"] = self._check_round
+        state["reports"] = {
+            str(rnd): {str(r): [ok, t]
+                       for r, (ok, t) in reports.items()}
+            for rnd, reports in self._reports.items()
+        }
+        state["groups"] = {
+            str(rnd): groups
+            for rnd, groups in self._groups.items()
+        }
+
+    def _restore_extra(self, state: dict) -> None:
+        """(lock held)"""
+        self._check_round = int(state.get("check_round", 0))
+        self._reports = {
+            int(rnd): {int(r): (bool(v[0]), float(v[1]))
+                       for r, v in reports.items()}
+            for rnd, reports in state.get("reports", {}).items()
+        }
+        self._groups = {
+            int(rnd): [[int(r) for r in group] for group in groups]
+            for rnd, groups in state.get("groups", {}).items()
+        }

@@ -29,6 +29,22 @@ from dlrover_tpu.master.shard.task_manager import TaskManager
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.sync_service import ElasticPsService, SyncService
 
+# report() payloads that mutate snapshotted control-plane state (the
+# early-return branches — join/reconnect/kv-add — sink inline). The
+# per-step/heartbeat/telemetry hot paths are intentionally absent.
+_MUTATING_REPORTS = (
+    msg.DatasetShardParams,
+    msg.TaskResult,
+    msg.LeaveRendezvousRequest,
+    msg.NetworkStatusReport,
+    msg.KeyValuePair,
+    msg.NodeFailureReport,
+    msg.NodeAddressReport,   # writes node-addr/<rank> into the kv store
+    msg.ShardCheckpoint,
+    msg.ScaleRequest,
+    msg.ModelInfo,
+)
+
 
 class MasterServicer:
     def __init__(
@@ -55,6 +71,16 @@ class MasterServicer:
         self.metric_collector = metric_collector  # optional: stats sink
         self._paral_config = msg.ParallelConfig()
         self._start_time = time.time()
+        # crash-consistency hook (wired by JobMaster): called after any
+        # request that may have mutated control-plane state, so every
+        # mutation lands in a durable snapshot before the next one
+        self.state_sink: Optional[callable] = None
+        # master generation token (bumped per restart over one state
+        # lineage); 0 = no state backend, tokens disabled
+        self.generation = 0
+        # step-driven chaos for the master itself (kill:master:0@step):
+        # wired by JobMaster, fed from worker GlobalStepReports
+        self.master_chaos = None
 
     # ------------------------------------------------------------------
     # raw byte endpoints (wired into comm.build_server)
@@ -84,12 +110,23 @@ class MasterServicer:
     # ------------------------------------------------------------------
     def get(self, request: msg.Message) -> msg.Message:
         if isinstance(request, msg.TaskRequest):
-            return self.task_manager.get_dataset_task(
+            # counter (not task emptiness) gates the snapshot: a final-
+            # epoch splitter flip mutates state yet answers WAIT/NONE
+            before = self.task_manager.mutation_count
+            task = self.task_manager.get_dataset_task(
                 request.worker_id, request.dataset_name
             )
+            if self.task_manager.mutation_count != before:
+                self._sink_state()
+            return task
         if isinstance(request, msg.CommWorldRequest):
             mgr = self.rdzv_managers[request.rdzv_name]
+            # polls vastly outnumber mutations: only a poll that actually
+            # changed rendezvous state (cut a round) pays for a snapshot
+            before = mgr.mutation_count
             rdzv_round, group, world = mgr.get_comm_world(request.node_id)
+            if mgr.mutation_count != before:
+                self._sink_state()
             return msg.CommWorld(rdzv_name=request.rdzv_name,
                                  round=rdzv_round, group=group, world=world)
         if isinstance(request, msg.WaitingNodeNumRequest):
@@ -98,8 +135,11 @@ class MasterServicer:
             # touch + dead-member reaping ride on it, so agent death is
             # detected even with no node manager (standalone masters)
             mgr.touch(request.node_id)
+            before = mgr.mutation_count
             mgr.reap_dead_nodes(
                 Context.singleton().dead_node_timeout_s)
+            if mgr.mutation_count != before:
+                self._sink_state()   # a dead member was reaped
             return msg.WaitingNodeNum(waiting_num=mgr.num_nodes_waiting())
         if isinstance(request, msg.KVGetRequest):
             return msg.KeyValuePair(key=request.key,
@@ -172,7 +212,11 @@ class MasterServicer:
                 rdzv_round = mgr.join_rendezvous(
                     request.node_rank, request.local_world_size,
                     request.node_ip)
-            return msg.JoinRendezvousResult(round=rdzv_round)
+            self._sink_state()
+            return msg.JoinRendezvousResult(round=rdzv_round,
+                                            generation=self.generation)
+        elif isinstance(request, msg.ReconnectRequest):
+            return self._handle_reconnect(request)
         elif isinstance(request, msg.LeaveRendezvousRequest):
             mgr = self.rdzv_managers[request.rdzv_name]
             mgr.leave_waiting(request.node_rank)
@@ -184,11 +228,17 @@ class MasterServicer:
             self.kv_store.set(request.key, request.value)
         elif isinstance(request, msg.KVAddRequest):
             value = self.kv_store.add(request.key, request.amount)
+            self._sink_state()
             return msg.KVIntResult(value=value)
         elif isinstance(request, msg.GlobalStepReport):
             self.speed_monitor.collect_worker_step(request.node_id,
                                                    request.step)
             self._touch_rendezvous(request.node_rank)
+            # deliberately NOT a snapshot trigger (the per-step hot
+            # path); the step high-water mark rides on the next
+            # control-plane mutation's snapshot
+            if self.master_chaos is not None:
+                self.master_chaos.maybe_inject(request.step)
         elif isinstance(request, msg.NodeResourceStats):
             if self.job_manager is not None:
                 self.job_manager.update_node_resource_usage(request)
@@ -245,7 +295,57 @@ class MasterServicer:
             logger.warning("report: unknown request %s",
                            type(request).__name__)
             ok, reason = False, "unknown request"
+        if isinstance(request, _MUTATING_REPORTS):
+            self._sink_state()
         return msg.Response(success=ok, reason=reason)
+
+    # ------------------------------------------------------------------
+    def _handle_reconnect(self, request: msg.ReconnectRequest
+                          ) -> msg.ReconnectResult:
+        """An agent lost us (or our predecessor) and is re-registering.
+        Its rank re-enters the alive set either way; ``world_intact``
+        tells it whether the workers it kept running still form the
+        master's latest world — or whether it must re-join rendezvous."""
+        name = request.rdzv_name or RendezvousName.TRAINING
+        mgr = self.rdzv_managers.get(name)
+        if mgr is None:
+            return msg.ReconnectResult(generation=self.generation)
+        mgr.add_alive_node(request.node_rank)
+        world = mgr.latest_world
+        latest_round = mgr.rdzv_round - 1
+        intact = (bool(world) and request.node_rank in world
+                  and request.rdzv_round == latest_round)
+        restarted = (self.generation != 0
+                     and request.generation != self.generation)
+        logger.info(
+            "agent %d reconnected (rank %d, saw generation %d, ours %d, "
+            "round %d): %s", request.node_id, request.node_rank,
+            request.generation, self.generation, request.rdzv_round,
+            "world intact" if intact else "must re-join rendezvous")
+        obs.get_flight_recorder().record_event(
+            "agent_reconnect", node=request.node_id,
+            rank=request.node_rank, world_intact=intact,
+            master_restarted=restarted)
+        obs.get_registry().counter(
+            "dlrover_tpu_agent_reconnects_total",
+            "Agents that re-registered after a master-lost episode",
+            labelnames=("world_intact",),
+        ).labels(world_intact=str(intact).lower()).inc()
+        self._sink_state()
+        return msg.ReconnectResult(generation=self.generation,
+                                   world_intact=intact,
+                                   round=latest_round)
+
+    def _sink_state(self) -> None:
+        """Post-mutation crash-consistency hook; snapshot failures must
+        never fail the RPC that triggered them."""
+        sink = self.state_sink
+        if sink is None:
+            return
+        try:
+            sink()
+        except Exception:  # noqa: BLE001 — durability is best-effort
+            logger.exception("control-plane state snapshot failed")
 
     # ------------------------------------------------------------------
     def _ingest_telemetry(self, report: msg.TelemetryReport) -> None:

@@ -80,6 +80,12 @@ class BatchDatasetManager:
         self._task_id_seq = 0
         self._completed_records = 0
         self._max_task_completed_time = 0.0
+        # bumped on every mutation of snapshotted state — including
+        # splitter epoch advances that yield NO task (a huge dataset's
+        # final sub-epoch flip must reach a snapshot even though the
+        # worker only got a WAIT/NONE answer). Gated on by the servicer
+        # so idle WAIT polls don't pay for a state export.
+        self.mutation_count = 0
 
     @property
     def dataset_name(self) -> str:
@@ -100,9 +106,12 @@ class BatchDatasetManager:
                         dataset_name=self.dataset_name)
         task = self.todo.popleft()
         self.doing[task.task_id] = DoingTask(task, worker_id)
+        self.mutation_count += 1
         return task
 
     def _create_todo_tasks(self) -> None:
+        self.mutation_count += 1   # the splitter advanced even if no
+        # shard comes back (final-epoch flip)
         self._splitter.create_shards()
         shards = self._splitter.get_shards()
         epoch = self._splitter.get_epoch()
@@ -126,6 +135,7 @@ class BatchDatasetManager:
         doing = self.doing.pop(task_id, None)
         if doing is None:
             return False, None
+        self.mutation_count += 1
         if success:
             elapsed = time.time() - doing.start_time
             self._max_task_completed_time = max(
@@ -144,6 +154,8 @@ class BatchDatasetManager:
                  if d.worker_id == worker_id]
         for tid in stale:
             self.todo.appendleft(self.doing.pop(tid).task)
+        if stale:
+            self.mutation_count += 1
         return len(stale)
 
     def recover_timeout_tasks(self, timeout_s: float) -> int:
@@ -155,6 +167,8 @@ class BatchDatasetManager:
             logger.warning("task %d of worker %d timed out; requeueing",
                            tid, doing.worker_id)
             self.todo.appendleft(doing.task)
+        if stale:
+            self.mutation_count += 1
         return len(stale)
 
     def completed(self) -> bool:
@@ -189,6 +203,72 @@ class BatchDatasetManager:
             completed_records=self._completed_records,
             sub_epoch_offset=getattr(self._splitter, "_sub_epoch_offset", 0),
         )
+
+    # -- crash-consistent state (master/state_backend.py) -----------------
+    # Unlike the worker-facing JSON checkpoint above (which folds doing
+    # into todo — a restarted JOB must re-do in-flight shards), the master
+    # snapshot keeps todo and doing distinct WITH task ids and owners: a
+    # restarted MASTER must neither re-dispatch a shard a live worker is
+    # still computing (double assignment) nor forget it (loss), and the
+    # worker's eventual TaskResult must still match by task_id.
+
+    @staticmethod
+    def _shard_entry(shard: Shard) -> list:
+        if shard.indices is not None:
+            return [shard.start, shard.end, shard.indices]
+        return [shard.start, shard.end]
+
+    @staticmethod
+    def _shard_from_entry(entry: list) -> Shard:
+        return Shard(start=entry[0], end=entry[1],
+                     indices=entry[2] if len(entry) > 2 else None)
+
+    def export_state(self) -> dict:
+        def task_entry(task: Task) -> dict:
+            return {"id": task.task_id, "epoch": task.epoch,
+                    "shard": self._shard_entry(task.shard)}
+
+        return {
+            "task_type": self._task_type,
+            "task_id_seq": self._task_id_seq,
+            "completed_records": self._completed_records,
+            "epoch": self._splitter.get_epoch(),
+            "sub_epoch_offset": getattr(self._splitter,
+                                        "_sub_epoch_offset", 0),
+            "todo": [task_entry(t) for t in self.todo],
+            "doing": [
+                {**task_entry(d.task), "worker_id": d.worker_id,
+                 "start_time": d.start_time}
+                for d in self.doing.values()
+            ],
+        }
+
+    def restore_state(self, state: dict) -> None:
+        def task_from(entry: dict) -> Task:
+            return Task(
+                task_id=int(entry["id"]),
+                task_type=self._task_type,
+                dataset_name=self.dataset_name,
+                shard=self._shard_from_entry(entry["shard"]),
+                epoch=int(entry.get("epoch", 0)),
+            )
+
+        self._task_id_seq = int(state.get("task_id_seq", 0))
+        self._completed_records = int(state.get("completed_records", 0))
+        self._splitter.epoch = int(state.get("epoch", 0))
+        if hasattr(self._splitter, "_sub_epoch_offset"):
+            self._splitter._sub_epoch_offset = int(
+                state.get("sub_epoch_offset", 0))
+        self.todo = deque(task_from(e) for e in state.get("todo", ()))
+        # in-flight tasks get a fresh timeout clock: charging the master's
+        # outage against task_timeout_s would requeue (and double-assign)
+        # shards their workers are still legitimately computing
+        now = time.time()
+        self.doing = {
+            int(e["id"]): DoingTask(task_from(e), int(e["worker_id"]),
+                                    start_time=now)
+            for e in state.get("doing", ())
+        }
 
     def restore_checkpoint(self, ckpt: DatasetShardCheckpoint) -> None:
         """Rebuild the todo queue from a checkpoint, discarding in-memory

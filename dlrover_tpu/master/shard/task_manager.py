@@ -8,6 +8,7 @@ workers and timed-out tasks, and exposes the data-position checkpoint.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Dict, Optional, Tuple
@@ -26,7 +27,19 @@ class TaskManager:
     def __init__(self):
         self._lock = threading.Lock()
         self._datasets: Dict[str, BatchDatasetManager] = {}
+        # registration params, kept verbatim so a restarted master can
+        # rebuild each dataset's splitter (master/state_backend.py)
+        self._params: Dict[str, DatasetShardParams] = {}
         self.speed_monitor = None   # wired by the job master
+
+    @property
+    def mutation_count(self) -> int:
+        """Aggregate mutation counter over every dataset (+ the set of
+        registrations itself): the servicer snapshots a TaskRequest only
+        when this moved — idle WAIT polls export nothing."""
+        with self._lock:
+            return len(self._datasets) + sum(
+                d.mutation_count for d in self._datasets.values())
 
     # -- dataset registration ---------------------------------------------
     def new_dataset(self, params: DatasetShardParams) -> None:
@@ -44,6 +57,7 @@ class TaskManager:
             self._datasets[params.dataset_name] = BatchDatasetManager(
                 params.task_type, splitter
             )
+            self._params[params.dataset_name] = params
             logger.info("registered dataset %s: size=%d shard=%d epochs=%d",
                         params.dataset_name, params.dataset_size,
                         params.shard_size, params.num_epochs)
@@ -115,6 +129,33 @@ class TaskManager:
         with self._lock:
             dataset = self._datasets.get(dataset_name)
             return dataset.get_epoch() if dataset else 0
+
+    # -- crash-consistent state (master/state_backend.py) ------------------
+    def export_state(self) -> dict:
+        with self._lock:
+            return {
+                "datasets": {
+                    name: {
+                        "params": dataclasses.asdict(self._params[name]),
+                        "progress": mgr.export_state(),
+                    }
+                    for name, mgr in self._datasets.items()
+                    if name in self._params
+                }
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Rebuild every dataset (splitter from its registration params,
+        progress from the manager snapshot). Registration stays
+        idempotent afterwards: a restarted worker re-registering the
+        dataset hits the existing new_dataset no-op path."""
+        for name, entry in state.get("datasets", {}).items():
+            params = DatasetShardParams(**entry["params"])
+            self.new_dataset(params)
+            with self._lock:
+                mgr = self._datasets.get(name)
+            if mgr is not None:
+                mgr.restore_state(entry.get("progress", {}))
 
     # -- data-position checkpoint -----------------------------------------
     def checkpoint_dataset(self, dataset_name: str

@@ -159,6 +159,24 @@ class SpeedMonitor:
                 return False
             return (time.time() - self._last_step_time) > hang_seconds
 
+    # -- crash-consistent state (master/state_backend.py) ------------------
+    def export_state(self) -> dict:
+        with self._lock:
+            return {"global_step": self._global_step,
+                    "tokens_per_step": self._tokens_per_step}
+
+    def restore_state(self, state: dict) -> None:
+        """Rehydrate the step high-water mark so post-failover hang
+        detection and the exposition don't restart from 0. Wall-clock
+        fields restart fresh: the first step delta after a master restart
+        spans the outage, not training."""
+        with self._lock:
+            self._global_step = int(state.get("global_step", 0))
+            self._tokens_per_step = int(state.get("tokens_per_step", 0))
+            self._last_step_time = time.time()
+            self._samples.clear()
+            self._skip_next_step_time = True
+
     def reset_running_speed(self) -> None:
         """Call at membership change: old samples reflect the old world,
         and the next step-report delta spans the failover gap — neither

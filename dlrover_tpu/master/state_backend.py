@@ -1,0 +1,177 @@
+"""Crash-consistent master state: a versioned, checksummed snapshot store.
+
+The job master is the one component whose death previously killed the job:
+rendezvous rounds, the node table, dataset task progress and the kv-store
+lived only in ``JobMaster``'s memory. This module gives the master durable
+control-plane state with the same guarantees a WAL-less embedded store can
+offer from atomic-rename filesystems:
+
+- **Atomicity**: every snapshot is written to a temp file in the same
+  directory and ``os.replace``d into place — a crash mid-write leaves the
+  previous snapshot intact, never a torn file.
+- **Integrity**: the snapshot wrapper carries a SHA-256 over the canonical
+  JSON of the state payload; ``load_latest`` verifies it and falls back to
+  the next-older snapshot on mismatch (torn disk, bit rot, truncation).
+- **Bounded retention**: only the newest ``retain`` snapshots are kept, so
+  a long job cannot fill the state volume.
+
+The store is deliberately schema-free (one JSON dict per snapshot); the
+``JobMaster`` composes the dict from each component's ``export_state()``
+and rebuilds them through ``restore_state()`` on restart — see
+docs/fault_tolerance.md for the snapshot format and recovery sequence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from dlrover_tpu import obs
+from dlrover_tpu.common.log import default_logger as logger
+
+_SNAPSHOT_RE = re.compile(r"^master-state-(\d{10})\.json$")
+_FORMAT_VERSION = 1
+
+
+def _canonical(state: Dict[str, Any]) -> str:
+    """Deterministic JSON for checksumming (and change detection)."""
+    return json.dumps(state, sort_keys=True, separators=(",", ":"))
+
+
+def _checksum(payload: str) -> str:
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+
+class SnapshotCorruptionError(RuntimeError):
+    """A snapshot file failed its checksum / structure validation."""
+
+
+class MasterStateBackend:
+    """Versioned snapshot files under one directory.
+
+    Concurrency: one writer (the master process — ``save*`` serializes on
+    an internal lock); readers (``load_latest``) tolerate the writer
+    replacing files underneath them because replacement is atomic.
+    """
+
+    def __init__(self, directory: str, retain: int = 5):
+        if retain < 1:
+            raise ValueError(f"retain must be >= 1, got {retain}")
+        self._dir = directory
+        self._retain = retain
+        self._lock = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+        existing = self.versions()
+        self._next_version = (existing[-1] + 1) if existing else 1
+        self._last_checksum = ""
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    def _path(self, version: int) -> str:
+        return os.path.join(self._dir, f"master-state-{version:010d}.json")
+
+    def versions(self) -> List[int]:
+        """Snapshot versions present on disk, oldest first."""
+        found = []
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return []
+        for name in names:
+            match = _SNAPSHOT_RE.match(name)
+            if match:
+                found.append(int(match.group(1)))
+        return sorted(found)
+
+    # -- writing -----------------------------------------------------------
+    def save(self, state: Dict[str, Any]) -> str:
+        """Write a new snapshot version atomically; returns its path."""
+        payload = _canonical(state)
+        return self._write(state, payload)
+
+    def save_if_changed(self, state: Dict[str, Any]) -> Optional[str]:
+        """Write only when the state differs from the last written
+        snapshot (the per-mutation hook: polls that mutate nothing must
+        not churn versions). Returns the path, or None when skipped."""
+        payload = _canonical(state)
+        with self._lock:
+            if self._last_checksum and \
+                    _checksum(payload) == self._last_checksum:
+                return None
+        return self._write(state, payload)
+
+    def _write(self, state: Dict[str, Any], payload: str) -> str:
+        digest = _checksum(payload)
+        with self._lock:
+            version = self._next_version
+            self._next_version += 1
+            path = self._path(version)
+            wrapper = {
+                "format": _FORMAT_VERSION,
+                "version": version,
+                "checksum": digest,
+                "state": state,
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(wrapper, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self._last_checksum = digest
+            self._prune()
+        obs.get_registry().counter(
+            "dlrover_tpu_master_snapshots_total",
+            "Control-plane state snapshots written").inc()
+        return path
+
+    def _prune(self) -> None:
+        """Drop snapshots beyond the retention window (lock held)."""
+        versions = self.versions()
+        for version in versions[:-self._retain]:
+            try:
+                os.remove(self._path(version))
+            except OSError:
+                pass
+
+    # -- reading -----------------------------------------------------------
+    def load_version(self, version: int) -> Dict[str, Any]:
+        """Load + verify one snapshot; raises SnapshotCorruptionError."""
+        path = self._path(version)
+        try:
+            with open(path) as f:
+                wrapper = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise SnapshotCorruptionError(
+                f"snapshot {path} unreadable: {e}") from e
+        state = wrapper.get("state")
+        if not isinstance(state, dict):
+            raise SnapshotCorruptionError(
+                f"snapshot {path} has no state dict")
+        if _checksum(_canonical(state)) != wrapper.get("checksum"):
+            raise SnapshotCorruptionError(
+                f"snapshot {path} failed its checksum")
+        return state
+
+    def load_latest(self) -> Optional[Tuple[Dict[str, Any], int]]:
+        """Newest valid snapshot as (state, version), walking backwards
+        past corrupt ones (each fallback is counted + logged loudly);
+        None when no valid snapshot exists."""
+        fallbacks = obs.get_registry().counter(
+            "dlrover_tpu_master_snapshot_fallbacks_total",
+            "Corrupt snapshots skipped during master recovery")
+        for version in reversed(self.versions()):
+            try:
+                return self.load_version(version), version
+            except SnapshotCorruptionError as e:
+                logger.error(
+                    "master state snapshot v%d is corrupt (%s); falling "
+                    "back to the previous snapshot", version, e)
+                fallbacks.inc()
+        return None

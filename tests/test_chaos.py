@@ -24,14 +24,27 @@ class TestChaosSpec:
         faults = parse_chaos("kill:worker:0@5;hang:worker:1@3:120;"
                              "slow:ps:2@4:0.5")
         assert faults[0] == ChaosFault("kill", "worker", 0, 5)
-        assert faults[1] == ChaosFault("hang", "worker", 1, 3, 120.0)
-        assert faults[2] == ChaosFault("slow", "ps", 2, 4, 0.5)
+        assert faults[1] == ChaosFault("hang", "worker", 1, 3, 120.0,
+                                       index=1)
+        assert faults[2] == ChaosFault("slow", "ps", 2, 4, 0.5, index=2)
+
+    def test_master_role_parses(self):
+        (fault,) = parse_chaos("kill:master:0@7")
+        assert fault.role == "master" and fault.at_step == 7
 
     def test_bad_spec_fails_loudly(self):
         with pytest.raises(ValueError, match="bad chaos fault"):
             parse_chaos("kill:worker@5")
         with pytest.raises(ValueError, match="unknown chaos action"):
             parse_chaos("explode:worker:0@5")
+
+    def test_negative_rank_rejected(self):
+        with pytest.raises(ValueError, match="negative rank"):
+            parse_chaos("kill:worker:-1@5")
+
+    def test_duplicate_faults_keep_distinct_indices(self):
+        faults = parse_chaos("hang:worker:0@2:1;hang:worker:0@2:1")
+        assert [f.index for f in faults] == [0, 1]
 
     def test_injector_filters_role_and_rank(self):
         inj = ChaosInjector(role="worker", rank=1,
@@ -58,12 +71,172 @@ class TestChaosSpec:
         assert sleeps == [5.0, 0.5, 0.5]         # slow: every step
 
 
+class TestChaosStateMarkers:
+    SPEC = "hang:worker:0@2:0.01;hang:worker:0@2:0.01"
+
+    def test_duplicate_faults_fire_independently(self, tmp_path,
+                                                 monkeypatch):
+        """Two identical faults must not collide on one marker file:
+        each fires exactly once per job."""
+        from dlrover_tpu.diagnostics import chaos as chaos_mod
+
+        sleeps = []
+        monkeypatch.setattr(chaos_mod.time, "sleep", sleeps.append)
+        monkeypatch.setenv("DLROVER_TPU_CHAOS_STATE", str(tmp_path))
+        inj = ChaosInjector(role="worker", rank=0, spec=self.SPEC)
+        inj.maybe_inject(2)
+        assert sleeps == [0.01, 0.01]
+        assert len(list(tmp_path.iterdir())) == 2
+
+    def test_state_persists_across_simulated_respawn(self, tmp_path,
+                                                     monkeypatch):
+        """A respawned process re-parses the same env; fired one-shots
+        must stay fired (markers pre-arm fault.fired)."""
+        from dlrover_tpu.diagnostics import chaos as chaos_mod
+
+        sleeps = []
+        monkeypatch.setattr(chaos_mod.time, "sleep", sleeps.append)
+        monkeypatch.setenv("DLROVER_TPU_CHAOS_STATE", str(tmp_path))
+        first = ChaosInjector(role="worker", rank=0, spec=self.SPEC)
+        first.maybe_inject(2)
+        assert sleeps == [0.01, 0.01]
+        respawn = ChaosInjector(role="worker", rank=0, spec=self.SPEC)
+        assert all(f.fired for f in respawn.faults)
+        respawn.maybe_inject(2)
+        assert sleeps == [0.01, 0.01]            # nothing re-fires
+
+    def test_hang_marker_written_after_the_sleep(self, tmp_path,
+                                                 monkeypatch):
+        """A process killed MID-hang must replay the hang on respawn:
+        the marker only exists once the sleep completed."""
+        from dlrover_tpu.diagnostics import chaos as chaos_mod
+
+        monkeypatch.setenv("DLROVER_TPU_CHAOS_STATE", str(tmp_path))
+        inj = ChaosInjector(role="worker", rank=0,
+                            spec="hang:worker:0@1:0.01")
+
+        def _check_no_marker_yet(duration):
+            assert list(tmp_path.iterdir()) == [], (
+                "hang marker written before the sleep")
+
+        monkeypatch.setattr(chaos_mod.time, "sleep", _check_no_marker_yet)
+        inj.maybe_inject(1)
+        assert len(list(tmp_path.iterdir())) == 1
+
+    def test_marker_claim_is_atomic(self, tmp_path, monkeypatch):
+        """A kill fault whose marker was already claimed by a racing
+        incarnation must NOT fire (os.kill never called)."""
+        from dlrover_tpu.diagnostics import chaos as chaos_mod
+
+        monkeypatch.setenv("DLROVER_TPU_CHAOS_STATE", str(tmp_path))
+        inj = ChaosInjector(role="worker", rank=0, spec="kill:worker:0@1")
+        # the racing twin claims the marker between construction and fire
+        (tmp_path / "chaos_0_kill_worker_0_1").write_text("other-pid")
+
+        def _boom(*a):
+            raise AssertionError("kill fired despite a claimed marker")
+
+        monkeypatch.setattr(chaos_mod.os, "kill", _boom)
+        inj.maybe_inject(1)
+        assert inj.faults[0].fired
+
+
+class TestTransportChaos:
+    def test_parse_net_grammar(self):
+        from dlrover_tpu.common.comm import parse_net_chaos
+
+        spec = parse_net_chaos("drop:0.2;delay:0.5:0.3;error:0.05")
+        assert spec.drop == 0.2
+        assert spec.delay_s == 0.5 and spec.delay_p == 0.3
+        assert spec.error == 0.05
+
+    def test_bad_net_spec_fails_loudly(self):
+        from dlrover_tpu.common.comm import parse_net_chaos
+
+        with pytest.raises(ValueError, match="unknown net fault"):
+            parse_net_chaos("flood:0.2")
+        with pytest.raises(ValueError, match="outside"):
+            parse_net_chaos("drop:1.5")
+        with pytest.raises(ValueError, match="bad net chaos fault"):
+            parse_net_chaos("drop:zero")
+
+    def test_drop_probability_honored(self):
+        from dlrover_tpu.common.comm import (
+            InjectedRpcError,
+            TransportFaultInjector,
+        )
+
+        inj = TransportFaultInjector("drop:0.5", seed=7)
+        outcomes = []
+        for _ in range(200):
+            try:
+                inj.before_rpc("get")
+                outcomes.append(False)
+            except InjectedRpcError as e:
+                import grpc
+
+                assert e.code() == grpc.StatusCode.UNAVAILABLE
+                outcomes.append(True)
+        dropped = sum(outcomes)
+        assert 60 <= dropped <= 140       # ~binomial(200, 0.5)
+        assert inj.injected["drop"] == dropped
+
+    def test_delay_probability_honored(self, monkeypatch):
+        from dlrover_tpu.common import comm as comm_mod
+
+        sleeps = []
+        monkeypatch.setattr(comm_mod.time, "sleep", sleeps.append)
+        inj = comm_mod.TransportFaultInjector("delay:0.25:0.5", seed=11)
+        for _ in range(200):
+            inj.before_rpc("report")
+        assert sleeps and all(s == 0.25 for s in sleeps)
+        assert 60 <= len(sleeps) <= 140
+        assert inj.injected["delay"] == len(sleeps)
+
+    def test_retries_ride_out_injected_unavailable(self):
+        """End to end over a real in-process master: a lossy injected
+        transport (50% drop) must be absorbed by retry_rpc — the typed
+        client call still succeeds, and the injector provably fired."""
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.common import messages as msg
+        from dlrover_tpu.common.comm import (
+            MasterStub,
+            TransportFaultInjector,
+        )
+        from dlrover_tpu.common.config import Context
+        from dlrover_tpu.master.job_master import JobMaster
+
+        master = JobMaster(port=0, min_nodes=1, max_nodes=1)
+        master.prepare()
+        Context.singleton().update(rpc_backoff_s=0.01,
+                                   rpc_backoff_max_s=0.02)
+        client = MasterClient(master.addr, node_id=0)
+        injector = TransportFaultInjector("drop:0.5", seed=3)
+        client._stub = MasterStub(client._channel,
+                                  fault_injector=injector)
+        try:
+            # report_dataset_shard_params and join_rendezvous both carry
+            # the full retry_rpc budget (10 attempts at 50% drop each)
+            for _ in range(5):
+                assert client.report_dataset_shard_params(
+                    msg.DatasetShardParams(
+                        dataset_name="ds", dataset_size=10, shard_size=5,
+                        task_type="training", storage_type="table"))
+            assert client.join_rendezvous(local_world_size=1) == 0
+            assert master.task_manager.get_dataset("ds") is not None
+            assert injector.injected["drop"] > 0
+        finally:
+            client.close()
+            master.stop(grace_s=0.1)
+            Context.reset()
+
+
 # slow@3 buys the step-2 async checkpoint commit 1.5 s of wall time
 # before the step-4 kill (steps on these tiny models are milliseconds —
 # a bare kill one step after the save reliably beats the commit, making
 # resume nondeterministic)
 _KILL_SPEC = "slow:worker:0@3:1.5;kill:worker:0@4"
-_KILL_MARKER = "chaos_kill_worker_0_4"
+_KILL_MARKER = "chaos_1_kill_worker_0_4"
 
 
 def _run_chaos_job(tmp_path, script, train_args,

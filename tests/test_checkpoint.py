@@ -245,3 +245,67 @@ def test_interval_gating(tiny_setup, cpu_devices, tmp_path):
         assert ckpt.maybe_save(11, state, force=True)   # forced
         ckpt.wait()
         assert sorted(ckpt.all_steps()) == [10, 11]
+
+
+def _corrupt_tree(root):
+    """Scramble every regular file under an Orbax step directory (the
+    torn-save / bit-rot stand-in)."""
+    import os
+
+    corrupted = 0
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            with open(os.path.join(dirpath, name), "wb") as f:
+                f.write(b"\x00corrupt\x00")
+            corrupted += 1
+    assert corrupted, f"nothing to corrupt under {root}"
+
+
+def test_restore_falls_back_past_corrupt_latest(tiny_setup, cpu_devices,
+                                                tmp_path):
+    """A corrupt newest checkpoint must not crash the trainer: restore
+    logs loudly, bumps the fallback counter, and resumes from the
+    next-older step."""
+    from dlrover_tpu import obs
+
+    cfg, model, tx = tiny_setup
+    mesh = create_mesh(MeshSpec(), cpu_devices[:1])
+    trainer = _make_trainer(model, tx, mesh, micro=2)
+    state = trainer.init(jax.random.PRNGKey(0))
+    tokens, targets = _batch(cfg, micro=2)
+    tok, tgt = trainer.shard_batch(tokens, targets)
+
+    fallbacks = obs.get_registry().counter(
+        "dlrover_tpu_checkpoint_restore_fallbacks_total")
+    with FlashCheckpointer(str(tmp_path / "c"),
+                           save_interval_steps=1) as ckpt:
+        assert ckpt.maybe_save(1, state)
+        ckpt.wait()
+        # trainer.step donates `state`; keep host copies for comparison
+        params_step1 = jax.tree.map(np.asarray, state.params)
+        state2, _ = trainer.step(state, tok, tgt)
+        assert ckpt.maybe_save(2, state2)
+        ckpt.wait()
+        assert sorted(ckpt.all_steps()) == [1, 2]
+        _corrupt_tree(str(tmp_path / "c" / "2"))
+
+        abstract = jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                              sharding=leaf.sharding),
+            state2,
+        )
+        before = fallbacks.get()
+        restored, _, step = ckpt.restore(abstract)
+        assert step == 1
+        # the poison step was quarantined, so the resumed trainer can
+        # re-reach step 2 and save there without colliding with it
+        assert sorted(ckpt.all_steps()) == [1]
+        assert ckpt.maybe_save(2, restored)
+        ckpt.wait()
+        assert sorted(ckpt.all_steps()) == [1, 2]
+    assert fallbacks.get() > before
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        params_step1, restored.params,
+    )

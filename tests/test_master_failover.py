@@ -1,0 +1,294 @@
+"""Master failover, in-process: crash-consistent state snapshot/restore
+over real RPC, and live agents riding out a master kill-and-restart
+(reconnect, re-register, world intact, no task lost or double-assigned,
+master_restore → reconnect → rendezvous visible in the flight dump)."""
+
+import json
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu import obs
+from dlrover_tpu.agent.elastic_agent import ElasticAgent, WorkerSpec
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.master.job_master import JobMaster
+
+SLEEPER = [sys.executable, "-c", "import time; time.sleep(120)"]
+
+
+@pytest.fixture()
+def failover_ctx(tmp_path):
+    """Shrink every reconnect/retry knob so master-loss paths run in
+    seconds, and point state + bootstrap at the test tmpdir."""
+    ctx = Context.singleton()
+    ctx.update(
+        rpc_timeout_s=1.0,
+        rpc_retries=2,
+        rpc_backoff_s=0.02,
+        rpc_backoff_max_s=0.05,
+        master_reconnect_timeout_s=60.0,
+        master_state_dir=str(tmp_path / "state"),
+        master_bootstrap_file=str(tmp_path / "master.addr"),
+    )
+    yield ctx
+    Context.reset()
+
+
+def _wait_for(predicate, timeout_s: float, what: str):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _shard_params(size=40, shard=10):
+    return msg.DatasetShardParams(
+        dataset_name="ds", dataset_size=size, shard_size=shard,
+        num_epochs=1, task_type="training", storage_type="table",
+    )
+
+
+class TestStateSurvivesMasterRestart:
+    def test_control_plane_state_survives_restart(self, failover_ctx,
+                                                  tmp_path):
+        """Drive a master over RPC, kill it, restore a new one from the
+        snapshot lineage: rendezvous round + world, task progress
+        (incl. in-flight tasks), kv contents and the step high-water
+        mark all survive; nothing is lost or double-assigned."""
+        master1 = JobMaster(port=0, min_nodes=2, max_nodes=2)
+        master1.prepare()
+        c0 = MasterClient(master1.addr, node_id=0)
+        c1 = MasterClient(master1.addr, node_id=1)
+        try:
+            c0.join_rendezvous(local_world_size=4)
+            c1.join_rendezvous(local_world_size=4)
+            _, _, world = c0.get_comm_world()
+            assert world == {0: 4, 1: 4}
+            assert c0.master_generation == 1
+
+            c0.report_dataset_shard_params(_shard_params())
+            t0a = c0.get_task("ds")
+            t0b = c0.get_task("ds")
+            t1 = c1.get_task("ds")
+            assert c0.report_task_result("ds", t0a.task_id, True)
+            c0.kv_set("coordinator", b"10.0.0.1:8476")
+            c0.report_global_step(7)
+            # GlobalStepReport is not a snapshot trigger (hot path);
+            # the next mutation persists the step high-water mark
+            c0.kv_set("after-step", b"1")
+        finally:
+            c0.close()
+            c1.close()
+        master1.stop(grace_s=0.1)
+
+        master2 = JobMaster(port=0, min_nodes=2, max_nodes=2)
+        master2.prepare()
+        c = MasterClient(master2.addr, node_id=2)
+        try:
+            assert master2.generation == 2
+            from dlrover_tpu.common.constants import RendezvousName
+
+            mgr = master2.rdzv_managers[RendezvousName.TRAINING]
+            assert mgr.rdzv_round == 1
+            assert mgr.latest_world == {0: 4, 1: 4}
+            # bootstrap file advertises the NEW master
+            with open(str(tmp_path / "master.addr")) as f:
+                assert f.read().strip() == master2.addr
+
+            # 4 shards: 1 done, 2 in flight, 1 never dispatched
+            assert master2.task_manager.counts("ds") == (1, 2)
+            dispatched = {t0a.shard.start, t0b.shard.start,
+                          t1.shard.start}
+            remaining = c.get_task("ds")
+            assert remaining.shard.start not in dispatched
+            # ... and in-flight shards are NOT re-dispatched
+            assert c.get_task("ds").task_type == "wait"
+            # the worker that held an in-flight task can still complete
+            # it by the original task id
+            assert c.report_task_result("ds", t1.task_id, True)
+
+            assert c.kv_get("coordinator") == b"10.0.0.1:8476"
+            assert master2.speed_monitor.completed_global_step == 7
+        finally:
+            c.close()
+            master2.stop(grace_s=0.1)
+
+    def test_corrupt_snapshot_falls_back_to_older(self, failover_ctx,
+                                                  tmp_path):
+        """A torn newest snapshot must not brick recovery: the restarted
+        master rebuilds from the previous valid version."""
+        master1 = JobMaster(port=0, min_nodes=1, max_nodes=1)
+        master1.prepare()
+        c0 = MasterClient(master1.addr, node_id=0)
+        try:
+            c0.kv_set("survives", b"yes")          # snapshot vN
+            c0.kv_set("lost-with-torn", b"gone")   # snapshot vN+1 (torn)
+        finally:
+            c0.close()
+        master1.stop(grace_s=0.1)
+        backend = master1._state_backend
+        latest = backend.versions()[-1]
+        with open(backend._path(latest), "w") as f:
+            f.write('{"version": %d, "torn' % latest)
+
+        master2 = JobMaster(port=0, min_nodes=1, max_nodes=1)
+        try:
+            assert master2.kv_store.get("survives") == b"yes"
+            # the torn snapshot's delta is lost — but recovery proceeds
+            assert master2.kv_store.get("lost-with-torn") == b""
+            assert master2.generation == 2
+        finally:
+            master2.stop(grace_s=0.1)
+
+
+class TestAgentsRideOutMasterRestart:
+    def test_agents_reconnect_and_keep_workers(self, failover_ctx,
+                                               tmp_path):
+        """Two live agents with running workers; the master dies and a
+        new one restores from the snapshot. Agents enter master-lost
+        mode, re-resolve the address from the bootstrap file, re-register
+        via the generation handshake, find their world intact, and keep
+        their workers running (same pids). The flight dump shows the
+        master_restore → reconnect → rendezvous span sequence."""
+        master1 = JobMaster(port=0, min_nodes=2, max_nodes=2)
+        master1.prepare()
+
+        agents = []
+        threads = []
+        for rank in (0, 1):
+            client = MasterClient(master1.addr, node_id=rank)
+            spec = WorkerSpec(
+                entrypoint=SLEEPER, devices_per_node=1,
+                max_restarts=0, monitor_interval_s=0.1,
+                rdzv_timeout_s=15.0, shutdown_grace_s=5.0,
+                enable_monitors=False, master_lost_after_polls=2,
+            )
+            agents.append(ElasticAgent(client, spec))
+        try:
+            for agent in agents:
+                thread = threading.Thread(target=agent.run, daemon=True)
+                thread.start()
+                threads.append(thread)
+            _wait_for(
+                lambda: all(a.last_round == 0 and a._proc is not None
+                            for a in agents),
+                15.0, "initial rendezvous + worker spawn")
+            pids = [a._proc.pid for a in agents]
+            world_before = dict(agents[0].last_world)
+            assert world_before == {0: 1, 1: 1}
+
+            master1.stop(grace_s=0.1)          # the control plane dies
+
+            master2 = JobMaster(port=0, min_nodes=2, max_nodes=2)
+            master2.prepare()                  # restores + re-advertises
+            try:
+                assert master2.generation == 2
+                _wait_for(
+                    lambda: all(
+                        a._client.master_addr == master2.addr
+                        and a._client.master_generation == 2
+                        for a in agents),
+                    30.0, "agents to reconnect to the restarted master")
+                from dlrover_tpu.common.constants import RendezvousName
+
+                mgr = master2.rdzv_managers[RendezvousName.TRAINING]
+                assert mgr.latest_world == world_before
+                # the coordinator bootstrap key survived with the kv
+                assert master2.kv_store.get(
+                    "coord/elastic-training/0") != b""
+                # world intact ⇒ the workers were never restarted
+                time.sleep(0.5)
+                assert [a._proc.pid for a in agents] == pids
+                assert all(a._proc.poll() is None for a in agents)
+
+                self._assert_span_sequence()
+            finally:
+                master2.stop(grace_s=0.1)
+        finally:
+            for agent in agents:
+                agent.shutdown()
+                agent._client.close()
+
+    def test_worker_crash_during_outage_reforms_world(self, failover_ctx,
+                                                      tmp_path):
+        """The compound failure: one agent's WORKER dies while the
+        master is down. Its restart path cannot rendezvous, so it must
+        fall into master-lost handling (the full reconnect budget, not
+        one RPC retry budget) and, once the restarted master serves,
+        re-join — the survivor is pulled into the new round via
+        num_nodes_waiting and the world re-forms with fresh workers."""
+        master1 = JobMaster(port=0, min_nodes=2, max_nodes=2)
+        master1.prepare()
+        agents = []
+        for rank in (0, 1):
+            client = MasterClient(master1.addr, node_id=rank)
+            spec = WorkerSpec(
+                entrypoint=SLEEPER, devices_per_node=1,
+                max_restarts=3, monitor_interval_s=0.1,
+                rdzv_timeout_s=15.0, shutdown_grace_s=5.0,
+                enable_monitors=False, master_lost_after_polls=2,
+            )
+            agents.append(ElasticAgent(client, spec))
+        try:
+            for agent in agents:
+                threading.Thread(target=agent.run, daemon=True).start()
+            _wait_for(
+                lambda: all(a.last_round == 0 and a._proc is not None
+                            for a in agents),
+                15.0, "initial rendezvous + worker spawn")
+            victim_pid = agents[0]._proc.pid
+
+            master1.stop(grace_s=0.1)
+            agents[0]._proc.kill()        # worker dies mid-outage
+
+            master2 = JobMaster(port=0, min_nodes=2, max_nodes=2)
+            master2.prepare()
+            try:
+                _wait_for(
+                    lambda: all(a.last_round == 1
+                                and a._proc is not None
+                                and a._proc.poll() is None
+                                for a in agents),
+                    45.0, "world to re-form at round 1 on the restarted "
+                          "master")
+                assert agents[0]._proc.pid != victim_pid
+                assert master2.rdzv_managers[
+                    "elastic-training"].latest_world == {0: 1, 1: 1}
+            finally:
+                master2.stop(grace_s=0.1)
+        finally:
+            for agent in agents:
+                agent.shutdown()
+                agent._client.close()
+
+    @staticmethod
+    def _assert_span_sequence():
+        """master_restore → reconnect → rendezvous(resync), ordered by
+        span completion, all in one dump (master + agents share the
+        in-process flight recorder)."""
+        path = obs.get_flight_recorder().dump(reason="failover-test")
+        with open(path) as f:
+            events = json.load(f)["events"]
+        spans = [e for e in events
+                 if e.get("kind") == "span" and e.get("status") == "ok"]
+
+        def end_of(name, **attrs):
+            matches = [
+                s for s in spans
+                if s["name"] == name
+                and all(s.get("attrs", {}).get(k) == v
+                        for k, v in attrs.items())
+            ]
+            assert matches, f"no ok span {name!r} ({attrs}) in the dump"
+            return max(s["end_ts"] for s in matches)
+
+        restore_end = end_of("master_restore")
+        reconnect_end = end_of("reconnect")
+        resync_end = end_of("rendezvous", resync=True, world_intact=True)
+        assert restore_end <= reconnect_end <= resync_end
