@@ -113,6 +113,14 @@ class ElasticAgent:
         self.metrics_file = os.path.join(self._workdir, "metrics.jsonl")
         self.chip_stats_file = os.path.join(self._workdir, "chips.json")
         self.paral_config_file = os.path.join(self._workdir, "paral.json")
+        # diagnosis plumbing: the worker exports its per-step phase
+        # timeline here, and picks up on-demand profiler captures the
+        # agent requests when executing a master `profile:{rank}` action
+        self.timeline_file = os.path.join(self._workdir, "timeline.json")
+        self.profile_request_file = os.path.join(
+            self._workdir, "profile_request.json")
+        self.profile_dump_dir = os.path.join(self._workdir, "profiles")
+        self._profile_request_seq = 0
         # Persistent XLA compile cache shared across worker restarts: an
         # elastic restart re-lowers the same programs, so the respawned
         # worker skips compilation — the dominant cost of a fast restore.
@@ -187,6 +195,8 @@ class ElasticAgent:
             NodeEnv.METRICS_FILE: self.metrics_file,
             NodeEnv.CHIP_STATS_FILE: self.chip_stats_file,
             NodeEnv.PARAL_CONFIG_PATH: self.paral_config_file,
+            NodeEnv.TIMELINE_FILE: self.timeline_file,
+            NodeEnv.PROFILE_REQUEST_FILE: self.profile_request_file,
         })
         env.setdefault("JAX_COMPILATION_CACHE_DIR", self.compile_cache_dir)
         return env
@@ -352,8 +362,13 @@ class ElasticAgent:
                 obs.get_flight_recorder().record_event("worker_hang")
                 self._restart_worker_resilient(count_against_budget=False)
                 continue
-            # Healthy: restart on membership change so the world re-forms
-            # (reference: training.py:483-486,510-521).
+            # Healthy: check membership first, then execute any
+            # diagnosis actions the master queued for this rank
+            # (reference: training.py:483-486,510-521). Actions are
+            # polled only after a SUCCESSFUL liveness probe: during a
+            # master outage an extra un-retried RPC here would block a
+            # full timeout per tick before the probe that actually
+            # advances the master-lost streak.
             try:
                 waiting = self._client.num_nodes_waiting(self._rdzv_name)
                 self._master_fail_streak = 0
@@ -364,6 +379,7 @@ class ElasticAgent:
                     self._master_fail_streak = 0
                     self._handle_master_loss()
                 continue
+            self._poll_diagnosis_actions()
             if waiting > 0:
                 logger.info(
                     "%d node(s) waiting: restarting worker to re-form the "
@@ -372,6 +388,56 @@ class ElasticAgent:
                 obs.get_flight_recorder().record_event(
                     "membership_restart", waiting=waiting)
                 self._restart_worker_resilient(count_against_budget=False)
+
+    # -- diagnosis actions -------------------------------------------------
+    def _poll_diagnosis_actions(self) -> None:
+        """Drain and execute the master's diagnosis actions for this
+        rank. Best-effort by contract: a failed poll is just skipped
+        (master-loss detection stays the num_nodes_waiting poll's job),
+        and an action that cannot execute must not kill the agent."""
+        try:
+            actions = self._client.poll_diagnosis_actions()
+        except Exception:  # noqa: BLE001 — droppable, next tick retries
+            return
+        for action in actions:
+            try:
+                self._execute_diagnosis_action(action)
+            except Exception:  # noqa: BLE001
+                logger.exception("diagnosis action failed: %s", action)
+
+    def _execute_diagnosis_action(self, action: dict) -> None:
+        kind = str(action.get("kind", "observe"))
+        reason = str(action.get("reason", ""))
+        obs.get_flight_recorder().record_event(
+            "diagnosis_action_executed", kind=kind,
+            id=action.get("id", 0), reason=reason[:256])
+        obs.get_registry().counter(
+            "dlrover_tpu_agent_diagnosis_actions_total",
+            "Diagnosis actions this agent executed",
+            labelnames=("kind",)).labels(kind=kind).inc()
+        if kind == "profile":
+            self._request_profile(action)
+        elif kind == "restart":
+            logger.warning("diagnosis: restarting worker (%s)", reason)
+            self._restart_worker_resilient(count_against_budget=False)
+        elif kind == "alert":
+            logger.warning("diagnosis alert: %s", reason)
+        else:
+            logger.info("diagnosis observe: %s", reason)
+
+    def _request_profile(self, action: dict) -> None:
+        """Round a master `profile:{rank}` action into an actual capture:
+        publish a request the worker's ProfilerSession polls each step
+        (obs/profiler.py); the capture artifact (trace dir + manifest)
+        lands under the agent workdir."""
+        self._profile_request_seq += 1
+        num_steps = int(action.get("num_steps", 5) or 5)
+        obs.write_profile_request(
+            self.profile_request_file, self._profile_request_seq,
+            num_steps, self.profile_dump_dir)
+        logger.info(
+            "diagnosis: requested a %d-step profiler capture (#%d) -> %s",
+            num_steps, self._profile_request_seq, self.profile_dump_dir)
 
     # -- master failover ---------------------------------------------------
     def _handle_master_loss(self) -> None:

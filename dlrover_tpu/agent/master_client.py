@@ -303,11 +303,52 @@ class MasterClient:
                 return self.kv_get(key)
 
     # -- health / status --------------------------------------------------
-    def report_global_step(self, step: int) -> bool:
+    def report_global_step(self, step: int, step_time_s: float = 0.0,
+                           data_wait_fraction: float = -1.0) -> bool:
+        """Step progress, optionally with the sender's windowed speed
+        evidence (mean step wall time + data-wait fraction from the
+        worker's phase timeline) — the diagnosis engine's straggler /
+        data-bound input."""
         return self._report(msg.GlobalStepReport(
             node_id=self.node_id, step=step, timestamp=time.time(),
-            node_rank=self.node_rank,
+            node_rank=self.node_rank, step_time_s=step_time_s,
+            data_wait_fraction=data_wait_fraction,
         )).success
+
+    # -- diagnosis --------------------------------------------------------
+    def poll_diagnosis_actions(self) -> list:
+        """Actions the master's diagnosis engine addressed to this rank
+        (single delivery — the caller must execute or drop them)."""
+        import json
+
+        result = self._get_typed(
+            msg.DiagnosisActionRequest(node_id=self.node_id,
+                                       node_rank=self.node_rank),
+            msg.DiagnosisActions,
+        )
+        if not result.actions_json:
+            return []
+        try:
+            actions = json.loads(result.actions_json)
+        except json.JSONDecodeError:
+            return []
+        return actions if isinstance(actions, list) else []
+
+    def get_diagnosis_reports(self, limit: int = 0) -> list:
+        """Recent DiagnosisReport dicts from the master (tools/diagnose)."""
+        import json
+
+        result = self._get_typed(
+            msg.DiagnosisReportRequest(limit=limit),
+            msg.DiagnosisReports,
+        )
+        if not result.reports_json:
+            return []
+        try:
+            reports = json.loads(result.reports_json)
+        except json.JSONDecodeError:
+            return []
+        return reports if isinstance(reports, list) else []
 
     def report_resource_stats(self, stats: msg.NodeResourceStats) -> bool:
         return self._report(stats).success

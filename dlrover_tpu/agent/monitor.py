@@ -30,16 +30,26 @@ from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
 
 
-def report_step(step: int, path: Optional[str] = None) -> None:
+def report_step(step: int, path: Optional[str] = None,
+                step_time_s: float = 0.0,
+                data_wait_fraction: float = -1.0) -> None:
     """Called from the TRAINING process each step (or every k steps).
     Atomic single-record write: readers only ever need the latest record,
-    and week-long jobs must not grow the file unboundedly."""
+    and week-long jobs must not grow the file unboundedly. The optional
+    timing fields (windowed mean step time + data-wait fraction, from
+    the phase timeline) ride along so the agent's TrainingMonitor can
+    forward the diagnosis engine's straggler evidence."""
     path = path or os.environ.get(NodeEnv.METRICS_FILE, "")
     if not path:
         return
+    record = {"step": int(step), "ts": time.time()}
+    if step_time_s > 0.0:
+        record["step_time_s"] = float(step_time_s)
+    if data_wait_fraction >= 0.0:
+        record["data_wait_fraction"] = float(data_wait_fraction)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        f.write(json.dumps({"step": int(step), "ts": time.time()}) + "\n")
+        f.write(json.dumps(record) + "\n")
     os.replace(tmp, path)
 
 
@@ -75,6 +85,16 @@ class ResourceMonitor:
         self._chip_stats_file = chip_stats_file
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # prime psutil's CPU sampler: cpu_percent(interval=None) computes
+        # utilization SINCE THE LAST CALL and returns a meaningless 0.0
+        # on its first — one throwaway call here makes every sample()
+        # real (an all-zero first report reads as an idle node)
+        try:
+            import psutil
+
+            psutil.cpu_percent(interval=None)
+        except ImportError:
+            pass
 
     def sample(self) -> msg.NodeResourceStats:
         cpu_percent = 0.0
@@ -91,6 +111,7 @@ class ResourceMonitor:
             node_type=self._node_type,
             cpu_percent=cpu_percent,
             memory_mb=memory_mb,
+            node_rank=getattr(self._client, "node_rank", -1),
             chip_stats=self._chip_stats(),
         )
         # same series the master exposes, in the agent's own registry
@@ -130,25 +151,57 @@ class ResourceMonitor:
                 logger.warning("resource report failed: %s", e)
 
 
-def export_chip_stats(path: Optional[str] = None) -> None:
+# last export's (wall time, step): the duty-cycle proxy needs a delta
+# to derive busy time from. One training process = one exporter, so a
+# module-level cell (no lock: only the step loop calls this) suffices.
+_chip_export_prev: dict = {}
+
+
+def export_chip_stats(path: Optional[str] = None,
+                      step: Optional[int] = None,
+                      step_time_s: float = 0.0) -> None:
     """Called from the TRAINING process: dump per-chip HBM usage for the
-    agent's ResourceMonitor to relay."""
+    agent's ResourceMonitor to relay.
+
+    Duty cycle: jax exposes no per-chip utilization counter, so a proxy
+    is derived from consecutive exports — steps completed since the last
+    export × the per-step DEVICE-BUSY seconds (``step_time_s``: mean
+    step time minus the host-starve phases; the caller derives it from
+    the phase timeline — total step time here would read ≈ 100% even
+    when the chips idle on a stalled input pipeline), over the
+    wall-clock elapsed. Callers that cannot supply (step, step_time_s)
+    get stats WITHOUT the field — an honest absence instead of a
+    hardcoded 0.0."""
     path = path or os.environ.get(NodeEnv.CHIP_STATS_FILE, "")
     if not path:
         return
     import jax
 
+    now = time.time()
+    duty: Optional[float] = None
+    prev = _chip_export_prev.get(path)
+    if step is not None and step_time_s > 0.0 and prev is not None:
+        elapsed = now - prev["ts"]
+        steps_done = step - prev["step"]
+        if elapsed > 0 and steps_done >= 0:
+            duty = min(100.0, 100.0 * steps_done * step_time_s / elapsed)
+    if step is not None:
+        _chip_export_prev[path] = {"ts": now, "step": int(step)}
     stats = []
     for device in jax.local_devices():
         mem = device.memory_stats() or {}
-        stats.append({
+        chip = {
             "index": device.id,
-            "duty_cycle_pct": 0.0,
             "hbm_used_mb": mem.get("bytes_in_use", 0) / (1 << 20),
             "hbm_total_mb": mem.get("bytes_limit", 0) / (1 << 20),
-        })
-    with open(path, "w") as f:
+        }
+        if duty is not None:
+            chip["duty_cycle_pct"] = duty
+        stats.append(chip)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(stats, f)
+    os.replace(tmp, path)
 
 
 class TrainingMonitor:
@@ -185,7 +238,15 @@ class TrainingMonitor:
                 self._last_reported = record["step"]
                 step_gauge.set(record["step"])
                 try:
-                    self._client.report_global_step(record["step"])
+                    # forward the worker's timing evidence when the
+                    # record carries it (diagnosis straggler input)
+                    self._client.report_global_step(
+                        record["step"],
+                        step_time_s=float(
+                            record.get("step_time_s", 0.0) or 0.0),
+                        data_wait_fraction=float(
+                            record.get("data_wait_fraction", -1.0)),
+                    )
                 except Exception as e:  # noqa: BLE001
                     logger.warning("step report failed: %s", e)
 

@@ -60,6 +60,42 @@ class Context:
         self.straggler_median_ratio: float = (
             DefaultValues.STRAGGLER_MEDIAN_RATIO
         )
+        # training diagnosis engine (master/diagnosis/): rule thresholds,
+        # cadence and the action kill-switch — see docs/observability.md
+        self.diagnosis_enabled: bool = DefaultValues.DIAGNOSIS_ENABLED
+        self.diagnosis_interval_s: float = (
+            DefaultValues.DIAGNOSIS_INTERVAL_S
+        )
+        self.diagnosis_worker_window: int = (
+            DefaultValues.DIAGNOSIS_WORKER_WINDOW
+        )
+        self.diagnosis_min_worker_samples: int = (
+            DefaultValues.DIAGNOSIS_MIN_WORKER_SAMPLES
+        )
+        self.straggler_trigger_windows: int = (
+            DefaultValues.STRAGGLER_TRIGGER_WINDOWS
+        )
+        self.straggler_clear_windows: int = (
+            DefaultValues.STRAGGLER_CLEAR_WINDOWS
+        )
+        self.diagnosis_data_wait_fraction: float = (
+            DefaultValues.DIAGNOSIS_DATA_WAIT_FRACTION
+        )
+        self.diagnosis_hbm_pressure_pct: float = (
+            DefaultValues.DIAGNOSIS_HBM_PRESSURE_PCT
+        )
+        self.diagnosis_collapse_ratio: float = (
+            DefaultValues.DIAGNOSIS_COLLAPSE_RATIO
+        )
+        self.diagnosis_actions_enabled: bool = (
+            DefaultValues.DIAGNOSIS_ACTIONS_ENABLED
+        )
+        self.diagnosis_profile_steps: int = (
+            DefaultValues.DIAGNOSIS_PROFILE_STEPS
+        )
+        self.diagnosis_action_cooldown_s: float = (
+            DefaultValues.DIAGNOSIS_ACTION_COOLDOWN_S
+        )
         self.seconds_per_scale_check: float = (
             DefaultValues.SECONDS_PER_SCALE_CHECK
         )

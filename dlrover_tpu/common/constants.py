@@ -102,6 +102,12 @@ class NodeEnv:
     # worker → agent handoff files (monitors tail these)
     METRICS_FILE = "DLROVER_TPU_METRICS_FILE"      # step-progress JSON lines
     CHIP_STATS_FILE = "DLROVER_TPU_CHIP_STATS"     # per-chip HBM usage JSON
+    # per-step phase timeline ring the worker exports (obs/timeline.py)
+    TIMELINE_FILE = "DLROVER_TPU_TIMELINE_FILE"
+    # agent → worker handoff: on-demand profiler capture requests
+    # (obs/profiler.py; the agent writes it when executing a master
+    # `profile:{rank}` diagnosis action)
+    PROFILE_REQUEST_FILE = "DLROVER_TPU_PROFILE_REQUEST"
 
 
 class TrainingMsgLevel:
@@ -181,3 +187,32 @@ class DefaultValues:
     SPEED_SAMPLE_WINDOW = 20
     STRAGGLER_MEDIAN_RATIO = 2.0    # t > ratio × median ⇒ straggler
     SECONDS_PER_SCALE_CHECK = 60.0
+    # training diagnosis engine (master/diagnosis/): the rule-based
+    # inference chain over per-worker step reports + resource stats
+    DIAGNOSIS_ENABLED = True
+    DIAGNOSIS_INTERVAL_S = 30.0
+    # per-worker step-time window (samples) straggler scoring runs over
+    DIAGNOSIS_WORKER_WINDOW = 20
+    # a worker needs this many samples before rules will judge it (a
+    # fresh joiner's first post-compile reports are not evidence)
+    DIAGNOSIS_MIN_WORKER_SAMPLES = 3
+    # hysteresis: consecutive over-threshold evaluations before a
+    # straggler is flagged, and consecutive clean ones before it clears
+    STRAGGLER_TRIGGER_WINDOWS = 2
+    STRAGGLER_CLEAR_WINDOWS = 2
+    # data-pipeline-bound attribution: windowed data-wait fraction above
+    # this means the step loop starves on input, not on compute
+    DIAGNOSIS_DATA_WAIT_FRACTION = 0.5
+    # HBM-pressure warning threshold (per-chip used/total %)
+    DIAGNOSIS_HBM_PRESSURE_PCT = 92.0
+    # throughput collapse: windowed steps/s under ratio × the observed
+    # high-water mark (with training in steady state) raises a report
+    DIAGNOSIS_COLLAPSE_RATIO = 0.5
+    # action grammar: observe / profile:{rank} / restart:{rank} / alert.
+    # False = diagnose-only (reports + metrics, no actions dispatched)
+    DIAGNOSIS_ACTIONS_ENABLED = True
+    # steps an on-demand profiler capture traces on the target worker
+    DIAGNOSIS_PROFILE_STEPS = 5
+    # per-rank cooldown between dispatched actions (a straggler that
+    # stays slow must not get a profile request every interval)
+    DIAGNOSIS_ACTION_COOLDOWN_S = 300.0

@@ -326,7 +326,9 @@ class NodeFailureReport(Message):
 @dataclass
 class ChipStats(Message):
     index: int = 0
-    duty_cycle_pct: float = 0.0
+    # < 0 = unknown (the exporter derives a duty-cycle proxy only when
+    # it has consecutive samples to derive it FROM; 0.0 would be a lie)
+    duty_cycle_pct: float = -1.0
     hbm_used_mb: float = 0.0
     hbm_total_mb: float = 0.0
 
@@ -337,6 +339,10 @@ class NodeResourceStats(Message):
     node_type: str = ""
     cpu_percent: float = 0.0
     memory_mb: float = 0.0
+    # rendezvous rank (see NodeHeartbeat.node_rank): the diagnosis
+    # engine keys all per-worker evidence by rank — node_id diverges
+    # from rank after a relaunch. -1 = sender predates the field.
+    node_rank: int = -1
     chip_stats: List[ChipStats] = field(default_factory=list)
 
 
@@ -363,6 +369,12 @@ class GlobalStepReport(Message):
     step: int = 0
     timestamp: float = 0.0
     node_rank: int = -1        # see NodeHeartbeat.node_rank
+    # per-worker speed evidence for the diagnosis engine: mean wall time
+    # per step and mean data-wait fraction over the sender's report
+    # window (from the worker's phase timeline, obs/timeline.py).
+    # 0.0 / -1.0 = sender predates the fields or has no timeline.
+    step_time_s: float = 0.0
+    data_wait_fraction: float = -1.0
 
 
 @dataclass
@@ -482,6 +494,42 @@ class TelemetryReport(Message):
     node_type: str = ""
     samples: List[MetricSample] = field(default_factory=list)
     spans_json: str = ""
+
+
+# --------------------------------------------------------------------------
+# Training diagnosis (master/diagnosis/): reports + the action grammar
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class DiagnosisActionRequest(Message):
+    """An agent polling for actions the diagnosis engine addressed to its
+    rank (observe / profile:{rank} / restart:{rank} / alert)."""
+
+    node_id: int = -1
+    node_rank: int = -1
+
+
+@dataclass
+class DiagnosisActions(Message):
+    """Actions popped for the polling rank. JSON list of action dicts
+    ({"id", "kind", "rank", "reason", ...}) — allowlist-friendly and
+    schema-stable across versions, like TelemetryReport.spans_json."""
+
+    actions_json: str = ""
+
+
+@dataclass
+class DiagnosisReportRequest(Message):
+    """tools/diagnose.py asking a live master for recent reports
+    (limit = 0 → everything retained)."""
+
+    limit: int = 0
+
+
+@dataclass
+class DiagnosisReports(Message):
+    reports_json: str = ""       # JSON list of DiagnosisReport dicts
 
 
 # --------------------------------------------------------------------------

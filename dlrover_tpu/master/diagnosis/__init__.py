@@ -1,0 +1,28 @@
+"""Training diagnosis engine: the layer where telemetry becomes
+decisions (docs/observability.md, "Diagnosis")."""
+
+from dlrover_tpu.master.diagnosis.manager import DiagnosisManager
+from dlrover_tpu.master.diagnosis.rules import (
+    DataPipelineBoundRule,
+    DiagnosisReport,
+    DiagnosisSnapshot,
+    HbmPressureRule,
+    StragglerRule,
+    ThroughputCollapseRule,
+    default_rules,
+    parse_action,
+    straggler_scores,
+)
+
+__all__ = [
+    "DataPipelineBoundRule",
+    "DiagnosisManager",
+    "DiagnosisReport",
+    "DiagnosisSnapshot",
+    "HbmPressureRule",
+    "StragglerRule",
+    "ThroughputCollapseRule",
+    "default_rules",
+    "parse_action",
+    "straggler_scores",
+]

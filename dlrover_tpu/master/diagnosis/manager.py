@@ -1,0 +1,320 @@
+"""DiagnosisManager: run the inference chain, persist reports, dispatch
+actions.
+
+The master-side consumer of everything PR 2's telemetry plumbing
+collects: on a fixed cadence (``Context.diagnosis_interval_s``) it
+snapshots the SpeedMonitor's per-worker step reports and the latest
+NodeResourceStats, runs the rule chain (rules.py), and for every
+conclusion
+
+- appends a :class:`DiagnosisReport` to a bounded ring (exported through
+  the PR 3 state backend so a restarted master keeps its history),
+- records a ``diagnosis`` flight event + bumps
+  ``dlrover_tpu_diagnosis_reports_total{rule,severity}``,
+- enqueues the report's actions onto per-rank queues agents drain via
+  the polled ``DiagnosisActionRequest`` RPC (kill-switch:
+  ``Context.diagnosis_actions_enabled``; per-rank cooldown so a
+  persistently slow rank is profiled once, not every interval).
+
+Threading: fed from servicer threads (``observe_resource_stats``,
+``poll_actions``) and read by scrapes while the diagnose loop runs —
+every shared structure is guarded by ``self._lock``. Rule evaluation is
+serialized under ``self._diag_lock`` (rule hysteresis state is lock-free
+by contract); ``_diag_lock`` may take ``self._lock`` inside it, never
+the reverse.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu import obs
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.diagnosis.rules import (
+    DiagnosisReport,
+    DiagnosisSnapshot,
+    Rule,
+    default_rules,
+    parse_action,
+    straggler_scores,
+)
+
+_REPORT_RING = 256        # reports retained in memory
+_PERSISTED_REPORTS = 64   # newest reports carried in state snapshots
+_ACTION_QUEUE_CAP = 8     # per-rank pending actions (drop-oldest)
+# resource stats older than this are not evidence (the node stopped
+# reporting — its last sample describes a process that may be gone)
+_STATS_FRESH_S = 120.0
+
+
+class DiagnosisManager:
+    def __init__(self, speed_monitor, rules: Optional[List[Rule]] = None):
+        self._speed_monitor = speed_monitor
+        self._rules = rules if rules is not None else default_rules()
+        self._lock = threading.Lock()
+        self._diag_lock = threading.Lock()
+        self._reports: deque = deque(maxlen=_REPORT_RING)
+        self._node_stats: Dict[int, Dict[str, Any]] = {}
+        self._pending: Dict[int, deque] = {}
+        self._last_action_ts: Dict[int, float] = {}
+        self._next_action_id = 1
+        self._published_scores: set = set()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # crash-consistency hook (JobMaster wires _maybe_snapshot): new
+        # reports should survive a master restart
+        self.state_sink: Optional[callable] = None
+        registry = obs.get_registry()
+        self._reports_total = registry.counter(
+            "dlrover_tpu_diagnosis_reports_total",
+            "Diagnosis reports emitted by the inference chain",
+            labelnames=("rule", "severity"))
+        self._actions_total = registry.counter(
+            "dlrover_tpu_diagnosis_actions_total",
+            "Diagnosis actions dispatched to agent queues",
+            labelnames=("kind",))
+        self._score_gauge = registry.gauge(
+            "dlrover_tpu_worker_straggler_score",
+            "Worker mean step time over the fleet median (1.0 = at the "
+            "pack)", labelnames=("node",))
+        self._wait_gauge = registry.gauge(
+            "dlrover_tpu_worker_data_wait_fraction",
+            "Windowed fraction of worker step time spent waiting on "
+            "data", labelnames=("node",))
+
+    # -- evidence feeds (servicer threads) ---------------------------------
+    def observe_resource_stats(self, stats: msg.NodeResourceStats) -> None:
+        # keyed by RANK when the sender provides one: every other piece
+        # of diagnosis evidence (step reports, action queues, eviction
+        # sets) is rank-keyed, and node_id diverges from rank after a
+        # relaunch — a node_id key here would dodge eviction and make
+        # HBM reports name a different identity space than straggler
+        # reports
+        rank = stats.node_rank if stats.node_rank >= 0 else stats.node_id
+        entry = {
+            "ts": time.time(),
+            "cpu_percent": stats.cpu_percent,
+            "memory_mb": stats.memory_mb,
+            "chips": [{"index": c.index,
+                       "duty_cycle_pct": c.duty_cycle_pct,
+                       "hbm_used_mb": c.hbm_used_mb,
+                       "hbm_total_mb": c.hbm_total_mb}
+                      for c in stats.chip_stats],
+        }
+        with self._lock:
+            self._node_stats[rank] = entry
+
+    def evict_workers(self, live) -> None:
+        """Membership-change hook: a departed rank's queued actions and
+        cached stats must not outlive it (an agent re-joining under the
+        same rank would execute a dead world's restart)."""
+        live_set = set(live)
+        with self._lock:
+            for table in (self._node_stats, self._pending,
+                          self._last_action_ts):
+                for rank in list(table):
+                    if rank not in live_set:
+                        table.pop(rank, None)
+
+    # -- the chain ---------------------------------------------------------
+    def snapshot(self) -> DiagnosisSnapshot:
+        now = time.time()
+        with self._lock:
+            stats = {rank: entry
+                     for rank, entry in self._node_stats.items()
+                     if now - entry["ts"] <= _STATS_FRESH_S}
+        return DiagnosisSnapshot(
+            ts=now,
+            worker_speeds=self._speed_monitor.worker_speeds(),
+            running_speed=self._speed_monitor.running_speed(),
+            peak_speed=self._speed_monitor.peak_speed(),
+            running_workers=self._speed_monitor.num_running_workers,
+            node_stats=stats,
+        )
+
+    def diagnose_once(self) -> List[DiagnosisReport]:
+        """One evaluation of the whole chain; safe to call from tests or
+        an operator path while the loop runs (serialized)."""
+        ctx = Context.singleton()
+        with self._diag_lock:
+            snap = self.snapshot()
+            self._publish_worker_gauges(snap, ctx)
+            reports: List[DiagnosisReport] = []
+            for rule in self._rules:
+                try:
+                    reports.extend(rule.evaluate(snap, ctx))
+                except Exception:  # noqa: BLE001 — one rule, not the chain
+                    logger.exception("diagnosis rule %s failed", rule.name)
+            for report in reports:
+                report.ts = report.ts or snap.ts
+                self._emit(report, ctx)
+        if reports and self.state_sink is not None:
+            try:
+                self.state_sink()
+            except Exception:  # noqa: BLE001 — durability is best-effort
+                logger.exception("diagnosis state snapshot failed")
+        return reports
+
+    def _publish_worker_gauges(self, snap: DiagnosisSnapshot,
+                               ctx: Context) -> None:
+        scores = straggler_scores(snap.worker_speeds,
+                                  ctx.diagnosis_min_worker_samples)
+        published = set()
+        for rank, score in scores.items():
+            self._score_gauge.labels(node=str(rank)).set(score)
+            published.add(rank)
+        for rank, speed in snap.worker_speeds.items():
+            if speed.data_wait_fraction >= 0.0:
+                self._wait_gauge.labels(node=str(rank)).set(
+                    speed.data_wait_fraction)
+                published.add(rank)
+        with self._lock:
+            stale = self._published_scores - published
+            self._published_scores = published
+        for rank in stale:  # dead ranks must not keep ranking in scrapes
+            self._score_gauge.remove(node=str(rank))
+            self._wait_gauge.remove(node=str(rank))
+
+    def _emit(self, report: DiagnosisReport, ctx: Context) -> None:
+        record = report.to_dict()
+        with self._lock:
+            self._reports.append(record)
+        self._reports_total.labels(rule=report.rule,
+                                   severity=report.severity).inc()
+        obs.get_flight_recorder().record_event(
+            "diagnosis", rule=report.rule, severity=report.severity,
+            worker=report.worker_id, summary=report.summary,
+            actions=list(report.actions))
+        logger.log(
+            30 if report.severity != "info" else 20,
+            "diagnosis [%s/%s]: %s", report.rule, report.severity,
+            report.summary)
+        if not ctx.diagnosis_actions_enabled:
+            return
+        for action in report.actions:
+            self._enqueue_action(action, report, ctx)
+
+    def _enqueue_action(self, action: str, report: DiagnosisReport,
+                        ctx: Context) -> None:
+        parsed = parse_action(action)
+        kind, rank = parsed["kind"], parsed["rank"]
+        if kind in ("observe", "alert") or rank < 0:
+            # advisory kinds surface through the report itself; only
+            # targeted kinds travel to an agent
+            return
+        now = time.time()
+        with self._lock:
+            last = self._last_action_ts.get(rank, 0.0)
+            if now - last < ctx.diagnosis_action_cooldown_s:
+                return
+            self._last_action_ts[rank] = now
+            queue = self._pending.get(rank)
+            if queue is None:
+                queue = deque(maxlen=_ACTION_QUEUE_CAP)
+                self._pending[rank] = queue
+            action_id = self._next_action_id
+            self._next_action_id += 1
+            entry = {
+                "id": action_id,
+                "kind": kind,
+                "rank": rank,
+                "rule": report.rule,
+                "reason": report.summary,
+                "ts": now,
+            }
+            if kind == "profile":
+                entry["num_steps"] = ctx.diagnosis_profile_steps
+            queue.append(entry)
+        self._actions_total.labels(kind=kind).inc()
+        obs.get_flight_recorder().record_event(
+            "diagnosis_action", kind=kind, rank=rank, id=entry["id"],
+            rule=report.rule)
+
+    # -- agent / tools endpoints (servicer threads) ------------------------
+    def poll_actions(self, node_rank: int) -> List[Dict[str, Any]]:
+        """Pop (single-delivery) every action queued for this rank."""
+        with self._lock:
+            queue = self._pending.get(node_rank)
+            if not queue:
+                return []
+            actions = list(queue)
+            queue.clear()
+            return actions
+
+    def reports(self, limit: int = 0) -> List[Dict[str, Any]]:
+        with self._lock:
+            records = list(self._reports)
+        if limit > 0:
+            records = records[-limit:]
+        return records
+
+    def pending_action_counts(self) -> Dict[int, int]:
+        with self._lock:
+            return {rank: len(queue)
+                    for rank, queue in self._pending.items() if queue}
+
+    # -- loop --------------------------------------------------------------
+    def start(self, interval_s: Optional[float] = None) -> None:
+        interval = (interval_s if interval_s is not None
+                    else Context.singleton().diagnosis_interval_s)
+
+        def _loop():
+            while not self._stopped.wait(interval):
+                try:
+                    self.diagnose_once()
+                except Exception:  # noqa: BLE001 — loop must survive
+                    logger.exception("diagnosis round failed")
+
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stopped.clear()
+            thread = threading.Thread(target=_loop, daemon=True,
+                                      name="diagnosis-manager")
+            self._thread = thread
+        thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._lock:
+            self._thread = None
+
+    # -- crash-consistent state (master/state_backend.py) ------------------
+    def export_state(self) -> dict:
+        with self._lock:
+            return {
+                "reports": list(self._reports)[-_PERSISTED_REPORTS:],
+                "next_action_id": self._next_action_id,
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Rehydrate report history + the action-id sequence. Pending
+        action queues and rule hysteresis deliberately restart empty:
+        they describe a world the restarted master has not re-observed
+        yet (agents re-register; evidence re-accumulates in one
+        window)."""
+        reports = state.get("reports", [])
+        with self._lock:
+            self._reports.clear()
+            for record in reports:
+                if isinstance(record, dict):
+                    self._reports.append(record)
+            self._next_action_id = max(
+                1, int(state.get("next_action_id", 1)))
+            self._pending.clear()
+            self._last_action_ts.clear()
+
+    # -- wire helpers ------------------------------------------------------
+    @staticmethod
+    def actions_to_json(actions: List[Dict[str, Any]]) -> str:
+        return json.dumps(actions) if actions else ""
+
+    @staticmethod
+    def reports_to_json(reports: List[Dict[str, Any]]) -> str:
+        return json.dumps(reports) if reports else ""

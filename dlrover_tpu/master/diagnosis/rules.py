@@ -1,0 +1,314 @@
+"""Diagnosis rules: the inference chain over job telemetry.
+
+Capability parity: dlrover/python/master/diagnosis — the reference runs
+an "inference chain" turning raw observations (worker speed, resource
+stats, heartbeats) into conclusions and actions. Re-design: each rule is
+a small stateful object evaluated over one immutable
+:class:`DiagnosisSnapshot`; a conclusion is a :class:`DiagnosisReport`
+carrying zero or more actions in the grammar
+``observe | profile:{rank} | restart:{rank} | alert``.
+
+Rule state (straggler hysteresis counters) is mutated ONLY inside
+``evaluate`` — the :class:`~dlrover_tpu.master.diagnosis.manager.
+DiagnosisManager` serializes evaluations under its own lock, so rules
+themselves stay lock-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.master.speed_monitor import WorkerSpeed
+
+# severity levels, mildest first
+INFO = "info"
+WARNING = "warning"
+CRITICAL = "critical"
+
+# action grammar kinds (docs/observability.md)
+ACTION_OBSERVE = "observe"
+ACTION_PROFILE = "profile"
+ACTION_RESTART = "restart"
+ACTION_ALERT = "alert"
+
+
+@dataclasses.dataclass
+class DiagnosisSnapshot:
+    """One immutable view of the evidence a diagnosis round runs over."""
+
+    ts: float
+    worker_speeds: Dict[int, WorkerSpeed]
+    running_speed: float = 0.0
+    peak_speed: float = 0.0
+    running_workers: int = 0
+    # worker_id -> {"cpu_percent", "memory_mb", "ts", "chips": [{...}]}
+    node_stats: Dict[int, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class DiagnosisReport:
+    """One conclusion of the chain (persisted, metered, rendered)."""
+
+    rule: str
+    severity: str
+    summary: str
+    ts: float = 0.0
+    worker_id: int = -1
+    details: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    actions: List[str] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any]) -> "DiagnosisReport":
+        return cls(
+            rule=str(raw.get("rule", "")),
+            severity=str(raw.get("severity", INFO)),
+            summary=str(raw.get("summary", "")),
+            ts=float(raw.get("ts", 0.0)),
+            worker_id=int(raw.get("worker_id", -1)),
+            details=dict(raw.get("details", {})),
+            actions=list(raw.get("actions", [])),
+        )
+
+
+def straggler_scores(worker_speeds: Dict[int, WorkerSpeed],
+                     min_samples: int = 1) -> Dict[int, float]:
+    """score = worker mean step time / median of its PEERS (1.0 = at the
+    pack; 2.0 = twice as slow). Leave-one-out deliberately: a median
+    that includes the candidate dilutes the signal — in a 2-worker job
+    the inclusive score is 2·t1/(t0+t1) < 2 however slow t1 gets, so a
+    ratio threshold ≥ 2 could NEVER fire. Workers below ``min_samples``
+    are excluded — and so is scoring entirely with < 2 eligible workers
+    (a solo worker cannot straggle relative to itself)."""
+    eligible = {w: s.mean_step_time_s for w, s in worker_speeds.items()
+                if s.samples >= min_samples and s.mean_step_time_s > 0}
+    if len(eligible) < 2:
+        return {}
+    scores = {}
+    for worker_id, mean_step in eligible.items():
+        peers = [t for w, t in eligible.items() if w != worker_id]
+        peer_median = statistics.median(peers)
+        if peer_median > 0:
+            scores[worker_id] = mean_step / peer_median
+    return scores
+
+
+class Rule:
+    name = "rule"
+
+    def evaluate(self, snapshot: DiagnosisSnapshot,
+                 ctx: Optional[Context] = None) -> List[DiagnosisReport]:
+        raise NotImplementedError
+
+
+class StragglerRule(Rule):
+    """Step-time vs moving median with hysteresis: a rank must score over
+    ``straggler_median_ratio`` for ``straggler_trigger_windows``
+    consecutive evaluations to be flagged (one slow window — a GC pause,
+    a checkpoint — is noise), and under it for
+    ``straggler_clear_windows`` to clear. Flagging emits a
+    ``profile:{rank}`` action so the evidence (an actual device trace)
+    collects itself."""
+
+    name = "straggler"
+
+    def __init__(self):
+        self._over: Dict[int, int] = {}     # consecutive over-threshold
+        self._under: Dict[int, int] = {}    # consecutive clean (flagged)
+        self._flagged: set = set()
+
+    def evaluate(self, snapshot, ctx=None):
+        ctx = ctx or Context.singleton()
+        scores = straggler_scores(snapshot.worker_speeds,
+                                  ctx.diagnosis_min_worker_samples)
+        reports: List[DiagnosisReport] = []
+        for worker_id, score in scores.items():
+            if score > ctx.straggler_median_ratio:
+                self._under.pop(worker_id, None)
+                count = self._over.get(worker_id, 0) + 1
+                self._over[worker_id] = count
+                if (worker_id not in self._flagged
+                        and count >= ctx.straggler_trigger_windows):
+                    self._flagged.add(worker_id)
+                    speed = snapshot.worker_speeds[worker_id]
+                    reports.append(DiagnosisReport(
+                        rule=self.name, severity=WARNING,
+                        worker_id=worker_id,
+                        summary=(
+                            f"worker {worker_id} is a straggler: "
+                            f"{speed.mean_step_time_s:.3f}s/step is "
+                            f"{score:.2f}x the peer median"),
+                        details={"score": round(score, 3),
+                                 "mean_step_time_s": round(
+                                     speed.mean_step_time_s, 4),
+                                 "samples": speed.samples,
+                                 "windows_over": count},
+                        actions=[f"{ACTION_PROFILE}:{worker_id}",
+                                 ACTION_ALERT],
+                    ))
+            else:
+                self._over.pop(worker_id, None)
+                if worker_id in self._flagged:
+                    count = self._under.get(worker_id, 0) + 1
+                    self._under[worker_id] = count
+                    if count >= ctx.straggler_clear_windows:
+                        self._flagged.discard(worker_id)
+                        self._under.pop(worker_id, None)
+                        reports.append(DiagnosisReport(
+                            rule=self.name, severity=INFO,
+                            worker_id=worker_id,
+                            summary=(f"worker {worker_id} recovered to "
+                                     f"{score:.2f}x the peer median"),
+                            details={"score": round(score, 3)},
+                            actions=[ACTION_OBSERVE],
+                        ))
+        # evidence for departed ranks must not linger (a re-joining rank
+        # would inherit a half-accumulated hysteresis count)
+        live = set(scores)
+        for table in (self._over, self._under):
+            for worker_id in list(table):
+                if worker_id not in live:
+                    table.pop(worker_id, None)
+        self._flagged &= live | {r.worker_id for r in reports}
+        return reports
+
+    @property
+    def flagged(self) -> set:
+        return set(self._flagged)
+
+
+class DataPipelineBoundRule(Rule):
+    """Data-wait fraction attribution: a worker spending most of its step
+    waiting on the input pipeline is starved, not slow — restarting or
+    profiling the device would point at the wrong subsystem."""
+
+    name = "data_pipeline_bound"
+
+    def __init__(self):
+        self._reported: set = set()
+
+    def evaluate(self, snapshot, ctx=None):
+        ctx = ctx or Context.singleton()
+        reports: List[DiagnosisReport] = []
+        bound = set()
+        for worker_id, speed in snapshot.worker_speeds.items():
+            if speed.samples < ctx.diagnosis_min_worker_samples:
+                continue
+            if speed.data_wait_fraction >= ctx.diagnosis_data_wait_fraction:
+                bound.add(worker_id)
+                if worker_id not in self._reported:
+                    self._reported.add(worker_id)
+                    reports.append(DiagnosisReport(
+                        rule=self.name, severity=WARNING,
+                        worker_id=worker_id,
+                        summary=(
+                            f"worker {worker_id} is data-pipeline bound: "
+                            f"{speed.data_wait_fraction:.0%} of step time "
+                            f"is data wait"),
+                        details={"data_wait_fraction": round(
+                            speed.data_wait_fraction, 3),
+                            "mean_step_time_s": round(
+                                speed.mean_step_time_s, 4)},
+                        actions=[ACTION_ALERT],
+                    ))
+        self._reported &= bound   # re-report if it regresses again later
+        return reports
+
+
+class ThroughputCollapseRule(Rule):
+    """Windowed steps/s under ``diagnosis_collapse_ratio`` × the world's
+    observed high-water mark. The peak resets at membership change
+    (SpeedMonitor.reset_running_speed), so a deliberate scale-down is a
+    new baseline, not a collapse."""
+
+    name = "throughput_collapse"
+
+    def __init__(self):
+        self._collapsed = False
+
+    def evaluate(self, snapshot, ctx=None):
+        ctx = ctx or Context.singleton()
+        if snapshot.peak_speed <= 0.0 or snapshot.running_speed <= 0.0:
+            return []
+        ratio = snapshot.running_speed / snapshot.peak_speed
+        if ratio < ctx.diagnosis_collapse_ratio:
+            if self._collapsed:
+                return []
+            self._collapsed = True
+            return [DiagnosisReport(
+                rule=self.name, severity=CRITICAL,
+                summary=(f"throughput collapsed to {ratio:.0%} of this "
+                         f"world's peak ({snapshot.running_speed:.2f} vs "
+                         f"{snapshot.peak_speed:.2f} steps/s)"),
+                details={"running_speed": round(snapshot.running_speed, 4),
+                         "peak_speed": round(snapshot.peak_speed, 4),
+                         "ratio": round(ratio, 3)},
+                actions=[ACTION_ALERT],
+            )]
+        self._collapsed = False
+        return []
+
+
+class HbmPressureRule(Rule):
+    """Per-chip HBM used/total over the pressure threshold: the next
+    resize or batch bump will OOM — warn while there is still headroom
+    to act."""
+
+    name = "hbm_pressure"
+
+    def __init__(self):
+        self._reported: set = set()
+
+    def evaluate(self, snapshot, ctx=None):
+        ctx = ctx or Context.singleton()
+        reports: List[DiagnosisReport] = []
+        pressured = set()
+        for worker_id, stats in snapshot.node_stats.items():
+            worst = 0.0
+            for chip in stats.get("chips", ()):
+                total = float(chip.get("hbm_total_mb", 0.0) or 0.0)
+                if total <= 0:
+                    continue
+                worst = max(worst, 100.0 * float(
+                    chip.get("hbm_used_mb", 0.0)) / total)
+            if worst >= ctx.diagnosis_hbm_pressure_pct:
+                pressured.add(worker_id)
+                if worker_id not in self._reported:
+                    self._reported.add(worker_id)
+                    reports.append(DiagnosisReport(
+                        rule=self.name, severity=WARNING,
+                        worker_id=worker_id,
+                        summary=(f"worker {worker_id} HBM pressure: "
+                                 f"{worst:.1f}% of a chip's HBM in use"),
+                        details={"worst_chip_pct": round(worst, 2)},
+                        actions=[ACTION_ALERT],
+                    ))
+        self._reported &= pressured
+        return reports
+
+
+def default_rules() -> List[Rule]:
+    """The chain, cheapest-evidence first."""
+    return [StragglerRule(), DataPipelineBoundRule(),
+            ThroughputCollapseRule(), HbmPressureRule()]
+
+
+def parse_action(action: str) -> Dict[str, Any]:
+    """``kind[:rank]`` → {"kind", "rank"}; unknown kinds map to observe
+    (an old agent must never crash on a newer master's grammar)."""
+    kind, _, rank = action.partition(":")
+    kind = kind.strip().lower()
+    if kind not in (ACTION_OBSERVE, ACTION_PROFILE, ACTION_RESTART,
+                    ACTION_ALERT):
+        kind = ACTION_OBSERVE
+    try:
+        target = int(rank) if rank else -1
+    except ValueError:
+        target = -1
+    return {"kind": kind, "rank": target}

@@ -72,6 +72,11 @@ class JobMaster:
         self.sync_service = SyncService(expected_workers=min_nodes)
         self.elastic_ps_service = ElasticPsService()
         self.job_manager = job_manager
+        self.diagnosis_manager = None
+        if ctx.diagnosis_enabled:
+            from dlrover_tpu.master.diagnosis import DiagnosisManager
+
+            self.diagnosis_manager = DiagnosisManager(self.speed_monitor)
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             rdzv_managers=self.rdzv_managers,
@@ -80,6 +85,7 @@ class JobMaster:
             sync_service=self.sync_service,
             elastic_ps_service=self.elastic_ps_service,
             job_manager=job_manager,
+            diagnosis_manager=self.diagnosis_manager,
         )
         self._host = host
         self._server, self.port = build_server(
@@ -106,8 +112,9 @@ class JobMaster:
             manager.add_event_callback(
                 TaskRescheduleCallback(self.task_manager))
             manager.add_event_callback(
-                RendezvousMembershipCallback(self.rdzv_managers,
-                                             self.speed_monitor))
+                RendezvousMembershipCallback(
+                    self.rdzv_managers, self.speed_monitor,
+                    diagnosis_manager=self.diagnosis_manager))
             manager.add_event_callback(
                 PsFailoverCallback(self.elastic_ps_service))
             self.job_manager = manager
@@ -152,6 +159,8 @@ class JobMaster:
                     "dlrover_tpu_master_restores_total",
                     "Masters rebuilt from a state snapshot").inc()
             self.servicer.state_sink = self._maybe_snapshot
+            if self.diagnosis_manager is not None:
+                self.diagnosis_manager.state_sink = self._maybe_snapshot
             # the generation bump itself must be durable before the
             # first RPC is served
             self._maybe_snapshot()
@@ -166,6 +175,8 @@ class JobMaster:
             "kv_store": self.kv_store.export_state(),
             "speed_monitor": self.speed_monitor.export_state(),
         }
+        if self.diagnosis_manager is not None:
+            state["diagnosis"] = self.diagnosis_manager.export_state()
         if self.job_manager is not None and \
                 hasattr(self.job_manager, "export_state"):
             state["job_manager"] = self.job_manager.export_state()
@@ -180,6 +191,8 @@ class JobMaster:
         self.task_manager.restore_state(state.get("task_manager", {}))
         self.kv_store.restore_state(state.get("kv_store", {}))
         self.speed_monitor.restore_state(state.get("speed_monitor", {}))
+        if self.diagnosis_manager is not None and "diagnosis" in state:
+            self.diagnosis_manager.restore_state(state["diagnosis"])
         if self.job_manager is not None and "job_manager" in state and \
                 hasattr(self.job_manager, "restore_state"):
             self.job_manager.restore_state(state["job_manager"])
@@ -297,6 +310,8 @@ class JobMaster:
         if self.auto_scaler is not None:
             self.auto_scaler.start()
         self.task_manager.start_timeout_recovery()
+        if self.diagnosis_manager is not None:
+            self.diagnosis_manager.start()
         self._start_metrics_exporter()
         self._publish_bootstrap_addr()
         # an unhandled master crash still leaves the job timeline on disk
@@ -385,6 +400,8 @@ class JobMaster:
                 self.metric_collector.stop()
             if self.auto_scaler is not None:
                 self.auto_scaler.stop()
+            if self.diagnosis_manager is not None:
+                self.diagnosis_manager.stop()
             if self.job_manager is not None:
                 self.job_manager.stop()
             if self._metrics_server is not None:

@@ -83,12 +83,15 @@ class PsFailoverCallback(NodeEventCallback):
 
 
 class RendezvousMembershipCallback(NodeEventCallback):
-    """Keep rendezvous managers' alive-node sets and the speed monitor in
-    sync with node lifecycle (the AllReduce path's membership bookkeeping)."""
+    """Keep rendezvous managers' alive-node sets, the speed monitor and
+    the diagnosis engine in sync with node lifecycle (the AllReduce
+    path's membership bookkeeping)."""
 
-    def __init__(self, rdzv_managers: Dict[str, object], speed_monitor):
+    def __init__(self, rdzv_managers: Dict[str, object], speed_monitor,
+                 diagnosis_manager=None):
         self._rdzv_managers = rdzv_managers
         self._speed_monitor = speed_monitor
+        self._diagnosis_manager = diagnosis_manager
 
     def on_node_started(self, node: Node) -> None:
         for mgr in self._rdzv_managers.values():
@@ -97,8 +100,22 @@ class RendezvousMembershipCallback(NodeEventCallback):
     def _drop(self, node: Node, graceful: bool = False) -> None:
         for mgr in self._rdzv_managers.values():
             mgr.remove_alive_node(node.rank_index, graceful=graceful)
-        self._speed_monitor.remove_running_worker(node.id)
+        from dlrover_tpu.common.constants import RendezvousName
+
+        training = self._rdzv_managers.get(RendezvousName.TRAINING)
+        live = training.alive_nodes if training is not None else set()
+        # evict BOTH keys a departed node may have reported under (rank
+        # for modern senders, node_id for legacy ones) so straggler
+        # scores never rank dead ranks — but node.id may COLLIDE with a
+        # surviving worker's rank (ids grow past the rank range on
+        # relaunch), and evicting a live rank's window resets its
+        # straggler evidence until the next rendezvous
+        self._speed_monitor.remove_running_worker(node.rank_index)
+        if node.id != node.rank_index and node.id not in live:
+            self._speed_monitor.remove_running_worker(node.id)
         self._speed_monitor.reset_running_speed()
+        if self._diagnosis_manager is not None:
+            self._diagnosis_manager.evict_workers(live)
 
     def on_node_succeeded(self, node: Node) -> None:
         # A clean exit must not invalidate the cut world — survivors are

@@ -89,6 +89,13 @@ class RendezvousManager:
         with self._lock:
             return self._mutations
 
+    @property
+    def alive_nodes(self) -> set:
+        """Ranks currently believed alive (the membership the speed
+        monitor / diagnosis engine must not outrank)."""
+        with self._lock:
+            return set(self._alive_nodes)
+
     def add_alive_node(self, node_rank: int) -> None:
         with self._lock:
             self._alive_nodes.add(node_rank)

@@ -19,6 +19,7 @@ from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.config import Context
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.diagnosis.manager import DiagnosisManager
 from dlrover_tpu.master.kv_store import KVStoreService
 from dlrover_tpu.master.rendezvous import (
     ElasticTrainingRendezvousManager,
@@ -57,6 +58,7 @@ class MasterServicer:
         elastic_ps_service: Optional[ElasticPsService] = None,
         job_manager=None,
         metric_collector=None,
+        diagnosis_manager=None,
     ):
         self.task_manager = task_manager or TaskManager()
         self.rdzv_managers: Dict[str, RendezvousManager] = rdzv_managers or {
@@ -69,6 +71,9 @@ class MasterServicer:
         self.elastic_ps_service = elastic_ps_service or ElasticPsService()
         self.job_manager = job_manager  # optional: node lifecycle owner
         self.metric_collector = metric_collector  # optional: stats sink
+        # optional: the diagnosis engine (master/diagnosis/) — fed from
+        # step/resource reports, drained by agent action polls
+        self.diagnosis_manager = diagnosis_manager
         self._paral_config = msg.ParallelConfig()
         self._start_time = time.time()
         # crash-consistency hook (wired by JobMaster): called after any
@@ -140,7 +145,22 @@ class MasterServicer:
                 Context.singleton().dead_node_timeout_s)
             if mgr.mutation_count != before:
                 self._sink_state()   # a dead member was reaped
+                self._evict_departed(mgr)
             return msg.WaitingNodeNum(waiting_num=mgr.num_nodes_waiting())
+        if isinstance(request, msg.DiagnosisActionRequest):
+            actions = []
+            if self.diagnosis_manager is not None:
+                actions = self.diagnosis_manager.poll_actions(
+                    request.node_rank if request.node_rank >= 0
+                    else request.node_id)
+            return msg.DiagnosisActions(
+                actions_json=DiagnosisManager.actions_to_json(actions))
+        if isinstance(request, msg.DiagnosisReportRequest):
+            reports = []
+            if self.diagnosis_manager is not None:
+                reports = self.diagnosis_manager.reports(request.limit)
+            return msg.DiagnosisReports(
+                reports_json=DiagnosisManager.reports_to_json(reports))
         if isinstance(request, msg.KVGetRequest):
             return msg.KeyValuePair(key=request.key,
                                     value=self.kv_store.get(request.key))
@@ -231,8 +251,15 @@ class MasterServicer:
             self._sink_state()
             return msg.KVIntResult(value=value)
         elif isinstance(request, msg.GlobalStepReport):
-            self.speed_monitor.collect_worker_step(request.node_id,
-                                                   request.step)
+            # keyed by RANK when the sender provides one: diagnosis
+            # actions address agents by rank (node_id diverges from rank
+            # after a relaunch), so the straggler evidence must too
+            self.speed_monitor.collect_worker_step(
+                request.node_rank if request.node_rank >= 0
+                else request.node_id,
+                request.step,
+                step_time_s=request.step_time_s,
+                data_wait_fraction=request.data_wait_fraction)
             self._touch_rendezvous(request.node_rank)
             # deliberately NOT a snapshot trigger (the per-step hot
             # path); the step high-water mark rides on the next
@@ -244,6 +271,8 @@ class MasterServicer:
                 self.job_manager.update_node_resource_usage(request)
             if self.metric_collector is not None:
                 self.metric_collector.collect_node_stats(request)
+            if self.diagnosis_manager is not None:
+                self.diagnosis_manager.observe_resource_stats(request)
             # the ResourceMonitor's payload made scrapeable on the master
             obs.publish_node_stats(request)
         elif isinstance(request, msg.NodeHeartbeat):
@@ -383,6 +412,16 @@ class MasterServicer:
                 return
             if isinstance(spans, list):
                 obs.record_remote_spans(spans, registry)
+
+    # ------------------------------------------------------------------
+    def _evict_departed(self, mgr) -> None:
+        """After a reap mutated membership: per-worker speed evidence,
+        straggler gauges and queued actions for the reaped ranks must go
+        with them (ISSUE: never rank dead ranks)."""
+        live = mgr.alive_nodes
+        self.speed_monitor.evict_departed(live)
+        if self.diagnosis_manager is not None:
+            self.diagnosis_manager.evict_workers(live)
 
     # ------------------------------------------------------------------
     def _touch_rendezvous(self, node_rank: int) -> None:

@@ -17,13 +17,28 @@ goes through ``self._lock``; registry observes happen OUTSIDE the lock
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, Optional, Set, Tuple
+from typing import Deque, Dict, Iterable, Optional, Set, Tuple
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.config import Context
+
+
+@dataclasses.dataclass
+class WorkerSpeed:
+    """Windowed per-worker speed evidence (the diagnosis engine's straggler
+    input): means over the last `samples` step reports that carried
+    timing (worker timelines, obs/timeline.py)."""
+
+    worker_id: int
+    samples: int = 0
+    mean_step_time_s: float = 0.0
+    data_wait_fraction: float = -1.0   # -1 = no timeline evidence
+    last_report_ts: float = 0.0
+    step: int = 0
 
 
 class SpeedMonitor:
@@ -38,6 +53,14 @@ class SpeedMonitor:
         self._last_step_time: float = time.time()
         self._workers: Set[int] = set()
         self._worker_steps: Dict[int, int] = {}
+        # worker_id -> deque[(step_time_s, data_wait_fraction, ts)] from
+        # step reports that carried timing evidence
+        self._worker_window = max(2, ctx.diagnosis_worker_window)
+        self._worker_times: Dict[int, Deque[Tuple[float, float, float]]] \
+            = {}
+        # steps/s high-water mark over the job (throughput-collapse
+        # baseline; survives window resets, cleared on restore)
+        self._peak_speed = 0.0
         self._start_training_time: Optional[float] = None
         self._paused_time_s: float = 0.0
         self._tokens_per_step: int = 0
@@ -92,13 +115,26 @@ class SpeedMonitor:
             self._global_step = step
             self._last_step_time = timestamp
             self._samples.append((timestamp, step))
+            speed = self._window_speed_locked()
+            if speed > self._peak_speed:
+                self._peak_speed = speed
         if step_time is not None:
             self._step_time_hist.observe(step_time)
 
-    def collect_worker_step(self, worker_id: int, step: int) -> None:
+    def collect_worker_step(self, worker_id: int, step: int,
+                            step_time_s: float = 0.0,
+                            data_wait_fraction: float = -1.0,
+                            timestamp: Optional[float] = None) -> None:
+        timestamp = timestamp or time.time()
         with self._lock:
             self._worker_steps[worker_id] = step
-        self.collect_global_step(step)
+            if step_time_s > 0.0:
+                window = self._worker_times.get(worker_id)
+                if window is None:
+                    window = deque(maxlen=self._worker_window)
+                    self._worker_times[worker_id] = window
+                window.append((step_time_s, data_wait_fraction, timestamp))
+        self.collect_global_step(step, timestamp)
 
     def set_start_training(self) -> None:
         with self._lock:
@@ -126,12 +162,58 @@ class SpeedMonitor:
     def running_speed(self) -> float:
         """Steps/second over the sample window."""
         with self._lock:
-            if len(self._samples) < 2:
-                return 0.0
-            (t0, s0), (t1, s1) = self._samples[0], self._samples[-1]
-            if t1 <= t0:
-                return 0.0
-            return (s1 - s0) / (t1 - t0)
+            return self._window_speed_locked()
+
+    def _window_speed_locked(self) -> float:
+        if len(self._samples) < 2:
+            return 0.0
+        (t0, s0), (t1, s1) = self._samples[0], self._samples[-1]
+        if t1 <= t0:
+            return 0.0
+        return (s1 - s0) / (t1 - t0)
+
+    def peak_speed(self) -> float:
+        """Steps/s high-water mark of the CURRENT world (reset at
+        membership change — a smaller world's sustainable speed is a new
+        baseline, not a collapse)."""
+        with self._lock:
+            return self._peak_speed
+
+    def worker_speeds(self) -> Dict[int, WorkerSpeed]:
+        """Windowed per-worker means for the diagnosis engine (only
+        workers whose reports carried timing evidence appear)."""
+        with self._lock:
+            out: Dict[int, WorkerSpeed] = {}
+            for worker_id, window in self._worker_times.items():
+                if not window:
+                    continue
+                times = [t for t, _, _ in window]
+                waits = [w for _, w, _ in window if w >= 0.0]
+                out[worker_id] = WorkerSpeed(
+                    worker_id=worker_id,
+                    samples=len(window),
+                    mean_step_time_s=sum(times) / len(times),
+                    data_wait_fraction=(sum(waits) / len(waits)
+                                        if waits else -1.0),
+                    last_report_ts=window[-1][2],
+                    step=self._worker_steps.get(worker_id, 0),
+                )
+            return out
+
+    def evict_departed(self, live: Iterable[int]) -> Set[int]:
+        """Drop per-worker state for every worker NOT in ``live`` (the
+        membership-change hook): straggler scoring and per-worker gauges
+        must never rank dead ranks. Returns the evicted ids."""
+        live_set = set(live)
+        with self._lock:
+            departed = ((set(self._worker_steps)
+                         | set(self._worker_times)
+                         | self._workers) - live_set)
+            for worker_id in departed:
+                self._workers.discard(worker_id)
+                self._worker_steps.pop(worker_id, None)
+                self._worker_times.pop(worker_id, None)
+        return departed
 
     def tokens_per_second(self) -> float:
         with self._lock:
@@ -150,6 +232,7 @@ class SpeedMonitor:
         with self._lock:
             self._workers.discard(worker_id)
             self._worker_steps.pop(worker_id, None)
+            self._worker_times.pop(worker_id, None)
 
     def is_hanged(self, hang_seconds: Optional[float] = None) -> bool:
         """No step progress for hang_seconds while training had started."""
@@ -176,11 +259,17 @@ class SpeedMonitor:
             self._last_step_time = time.time()
             self._samples.clear()
             self._skip_next_step_time = True
+            self._peak_speed = 0.0
+            self._worker_times.clear()
 
     def reset_running_speed(self) -> None:
         """Call at membership change: old samples reflect the old world,
         and the next step-report delta spans the failover gap — neither
-        belongs in the steady-state series."""
+        belongs in the steady-state series. The peak-speed baseline and
+        per-worker timing windows reset too: they describe the OLD
+        world's sustainable throughput."""
         with self._lock:
             self._samples.clear()
             self._skip_next_step_time = True
+            self._peak_speed = 0.0
+            self._worker_times.clear()

@@ -45,11 +45,14 @@ class JobMetricCollector:
 
     # -- ingest (called from the servicer path) -------------------------
     def collect_node_stats(self, stats: msg.NodeResourceStats) -> None:
-        duty = 0.0
         hbm = 0.0
+        # duty_cycle_pct = -1.0 means the sender could not derive one
+        # (first export, no step context) — averaging the sentinel in
+        # would hand the brain a fabricated "idle" reading
+        known = [c.duty_cycle_pct for c in stats.chip_stats
+                 if c.duty_cycle_pct >= 0.0]
+        duty = sum(known) / len(known) if known else -1.0
         if stats.chip_stats:
-            duty = sum(c.duty_cycle_pct for c in stats.chip_stats) / len(
-                stats.chip_stats)
             hbm = sum(c.hbm_used_mb for c in stats.chip_stats)
         self.stats.add_node_sample(
             stats.node_type or NodeType.WORKER, stats.node_id,
@@ -117,6 +120,10 @@ class JobMetricCollector:
         ]
         if latest:
             payload["cpu_percent"] = max(s.cpu_percent for s in latest)
-            payload["chip_duty_cycle_pct"] = (
-                sum(s.chip_duty_cycle_pct for s in latest) / len(latest))
+            known = [s.chip_duty_cycle_pct for s in latest
+                     if s.chip_duty_cycle_pct >= 0.0]
+            if known:
+                # omitted entirely when no node has derived one yet —
+                # the brain's hot-host rule must read "unknown", not 0%
+                payload["chip_duty_cycle_pct"] = sum(known) / len(known)
         return payload

@@ -32,6 +32,12 @@ from dlrover_tpu.obs.metrics import (
     get_registry,
     start_http_exporter,
 )
+from dlrover_tpu.obs.profiler import (
+    ProfilerCapture,
+    ProfilerSession,
+    read_profile_result,
+    write_profile_request,
+)
 from dlrover_tpu.obs.spans import (
     Span,
     SpanExporter,
@@ -42,25 +48,32 @@ from dlrover_tpu.obs.spans import (
     remove_span_sink,
     span,
 )
+from dlrover_tpu.obs.timeline import StepTimeline, load_timeline
 
 __all__ = [
     "DEFAULT_BUCKETS",
     "FLIGHT_DIR_ENV",
     "FlightRecorder",
     "MetricsRegistry",
+    "ProfilerCapture",
+    "ProfilerSession",
     "Span",
     "SpanExporter",
+    "StepTimeline",
     "add_span_sink",
     "current_context",
     "current_span",
     "get_flight_recorder",
     "get_registry",
+    "load_timeline",
     "publish_node_stats",
+    "read_profile_result",
     "record_remote_spans",
     "record_span",
     "remove_span_sink",
     "span",
     "start_http_exporter",
+    "write_profile_request",
 ]
 
 _defaults_lock = threading.Lock()
@@ -138,13 +151,17 @@ def publish_node_stats(stats, registry: MetricsRegistry = None) -> None:
         **labels).set(stats.memory_mb)
     if stats.chip_stats:
         hbm = sum(c.hbm_used_mb for c in stats.chip_stats)
-        duty = sum(c.duty_cycle_pct for c in stats.chip_stats
-                   ) / len(stats.chip_stats)
         registry.gauge("dlrover_tpu_node_hbm_used_mb",
                        "Sum of per-chip HBM in use",
                        labelnames=("node", "type")).labels(
             **labels).set(hbm)
-        registry.gauge("dlrover_tpu_node_chip_duty_cycle_pct",
-                       "Mean per-chip duty cycle",
-                       labelnames=("node", "type")).labels(
-            **labels).set(duty)
+        # duty < 0 is the "unknown" sentinel (agent/monitor.py
+        # export_chip_stats only emits a value when it can derive the
+        # proxy): averaging it in would fabricate utilization
+        known = [c.duty_cycle_pct for c in stats.chip_stats
+                 if c.duty_cycle_pct >= 0.0]
+        if known:
+            registry.gauge("dlrover_tpu_node_chip_duty_cycle_pct",
+                           "Mean per-chip duty cycle",
+                           labelnames=("node", "type")).labels(
+                **labels).set(sum(known) / len(known))

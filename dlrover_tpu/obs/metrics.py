@@ -164,6 +164,18 @@ class _Family:
                 self._children[key] = child
         return child
 
+    def remove(self, **labels) -> bool:
+        """Drop one labeled child (e.g. a per-worker gauge after the
+        worker left the job — a stale series would keep ranking a dead
+        rank in every scrape). Returns whether a child was removed."""
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labels)}, "
+                f"declared {sorted(self.labelnames)}")
+        key = tuple(str(labels[n]) for n in self.labelnames)
+        with self._lock:
+            return self._children.pop(key, None) is not None
+
     # unlabeled conveniences -------------------------------------------
     def _default(self):
         if self.labelnames:

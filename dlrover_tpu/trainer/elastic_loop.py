@@ -137,7 +137,25 @@ class ElasticTrainLoop:
         self.last_restore_timings: Dict[str, float] = {}
         self._chaos = None  # built lazily: env may be set post-init
         self._prev_sigterm = None
-        self._profiling = False
+        # per-step phase attribution (data-wait / h2d / compute /
+        # checkpoint), exported beside the metrics file for the agent +
+        # tools/diagnose.py; the windowed means ride on step reports as
+        # the master's straggler / data-bound evidence
+        from dlrover_tpu.common.constants import NodeEnv
+
+        self.timeline = obs.StepTimeline(
+            role="worker",
+            rank=int(os.environ.get(NodeEnv.NODE_RANK, "-1")))
+        self._timeline_path = os.environ.get(NodeEnv.TIMELINE_FILE, "")
+        self._timeline_exported_at = 0.0
+        # profiler: static window (config) + on-demand captures the
+        # agent requests on behalf of a master `profile:{rank}` action
+        self.profiler = obs.ProfilerSession(
+            request_path=os.environ.get(NodeEnv.PROFILE_REQUEST_FILE, ""),
+            static_dir=config.profile_dir,
+            static_start=config.profile_start_step,
+            static_num=config.profile_num_steps,
+        )
         logger.info(
             "elastic loop: dp=%d accum=%d micro(global)=%d mesh=%s",
             self.dp, self.accum, self.micro_global,
@@ -273,8 +291,6 @@ class ElasticTrainLoop:
     ) -> Tuple[Any, Dict[str, float]]:
         """Train over (tokens, targets) global batches. Returns the final
         state and last metrics."""
-        config = self.config
-        step = start_step
         raw_metrics: Dict[str, Any] = {}
         try:
             return self._run_inner(state, batches, start_step, sampler,
@@ -283,7 +299,7 @@ class ElasticTrainLoop:
             # a step failure (the expected failure mode here) must still
             # flush an active profiler trace, or the next loop's
             # start_trace raises on the dangling session
-            self._stop_profile()
+            self.profiler.stop()
 
     def _run_inner(self, state, batches, start_step, sampler,
                    raw_metrics):
@@ -299,9 +315,18 @@ class ElasticTrainLoop:
             "dlrover_tpu_worker_step_seconds",
             "Host wall-clock per train-loop iteration (dispatch-bound "
             "unless a host sync lands in the step)")
-        for tokens, targets in batches:
+        batch_iter = iter(batches)
+        while True:
+            # data-wait measured explicitly: the time this loop starves
+            # on the input pipeline is the diagnosis engine's
+            # "pipeline-bound, not a hardware straggler" signal
             t_step = _time.monotonic()
-            self._maybe_profile(step - start_step)
+            try:
+                tokens, targets = next(batch_iter)
+            except StopIteration:
+                break
+            t_data = _time.monotonic()
+            self.profiler.poll(step - start_step)
             tok, tgt = self.trainer.shard_batch(tokens, targets)
             state, raw_metrics = self.trainer.step(state, tok, tgt)
             step += 1
@@ -309,19 +334,31 @@ class ElasticTrainLoop:
             self._chaos.maybe_inject(step)
             if sampler is not None:
                 sampler.record_batch(config.global_batch)
-            step_hist.observe(_time.monotonic() - t_step)
-            if (self.client is not None
-                    and step % config.report_interval_steps == 0):
-                try:
-                    self.client.report_global_step(step)
-                except Exception:
-                    pass
-                self._flush_telemetry()
+            t_compute_end = _time.monotonic()
+            # from AFTER the batch fetch, as before the timeline landed:
+            # this series' meaning (dispatch-bound step time) must not
+            # silently absorb data wait — that lives in the timeline and
+            # the data_wait_fraction gauge
+            step_hist.observe(t_compute_end - t_data)
+            ckpt_s = 0.0
             if self.checkpointer is not None:
                 forced = self._stop_requested.is_set()
                 self.checkpointer.maybe_save(
                     step, state, self._data_state(sampler), force=forced,
                 )
+                ckpt_s = _time.monotonic() - t_compute_end
+            self.timeline.record(
+                step, _time.monotonic() - t_step,
+                data_wait=t_data - t_step,
+                h2d=getattr(self.trainer, "last_shard_batch_s", 0.0),
+                compute=getattr(self.trainer, "last_step_dispatch_s",
+                                t_compute_end - t_data),
+                checkpoint=ckpt_s,
+            )
+            if (self.client is not None
+                    and step % config.report_interval_steps == 0):
+                self._report_progress(step)
+                self._flush_telemetry()
             if self._stop_requested.is_set():
                 logger.info("stopping at step %d on request", step)
                 obs.get_flight_recorder().record_event(
@@ -341,27 +378,57 @@ class ElasticTrainLoop:
         if self.checkpointer is not None:
             with obs.span("checkpoint_wait"):
                 self.checkpointer.wait()
+        if self._timeline_path:
+            # final flush: runs shorter than a report interval must
+            # still leave a timeline on disk for postmortems
+            self.timeline.export(self._timeline_path)
         self._flush_telemetry()
         return state, metrics
 
-    # -- profiling ---------------------------------------------------------
-    def _maybe_profile(self, local_step: int) -> None:
-        config = self.config
-        if not config.profile_dir:
-            return
-        if local_step == config.profile_start_step and not self._profiling:
-            logger.info("profiler: tracing %d steps to %s",
-                        config.profile_num_steps, config.profile_dir)
-            jax.profiler.start_trace(config.profile_dir)
-            self._profiling = True
-        elif self._profiling and local_step >= (
-                config.profile_start_step + config.profile_num_steps):
-            self._stop_profile()
+    # -- progress reporting ------------------------------------------------
+    def _report_progress(self, step: int) -> None:
+        """Report-interval bookkeeping: ship the step report (with the
+        timeline's windowed speed evidence), export the timeline ring
+        and the per-chip HBM stats for the agent. All best-effort — the
+        step loop must survive a dead master and a full disk."""
+        stats = self.timeline.window_stats(
+            self.config.report_interval_steps)
+        mean_step = stats.get("mean_step_s", 0.0)
+        try:
+            self.client.report_global_step(
+                step, step_time_s=mean_step,
+                data_wait_fraction=stats.get("data_wait_fraction", -1.0))
+        except Exception:  # noqa: BLE001 — droppable by contract
+            pass
+        # tail-only AND wall-clock throttled on the hot path: the
+        # write+rename alone costs ~1 ms on slow filesystems, so fast
+        # steps with a short report interval would blow the < 1 %
+        # overhead budget; at most one export/second bounds the cost at
+        # ~0.1 % of training regardless of step time. The end-of-run
+        # flush writes the whole ring.
+        import time as _time
 
-    def _stop_profile(self) -> None:
-        if self._profiling:
-            jax.profiler.stop_trace()
-            self._profiling = False
+        now = _time.monotonic()
+        if self._timeline_path and now - self._timeline_exported_at >= 1.0:
+            self._timeline_exported_at = now
+            self.timeline.export(
+                self._timeline_path,
+                last_n=2 * self.config.report_interval_steps)
+        try:
+            from dlrover_tpu.agent.monitor import export_chip_stats
+
+            # duty-cycle proxy wants the per-step seconds the DEVICE is
+            # plausibly busy: the whole step minus the phases where the
+            # host is starving it (input wait, blocking checkpoint).
+            # Passing total step time would make duty ≈ 100% even on a
+            # worker spending most of each step waiting on data.
+            busy_fraction = max(
+                0.0, 1.0 - max(0.0, stats.get("data_wait_fraction", 0.0))
+                - stats.get("checkpoint_fraction", 0.0))
+            export_chip_stats(step=step,
+                              step_time_s=mean_step * busy_fraction)
+        except Exception:  # noqa: BLE001 — stats are advisory
+            pass
 
     def _data_state(self, sampler) -> Dict[str, Any]:
         data_state: Dict[str, Any] = {}

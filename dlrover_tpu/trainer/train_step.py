@@ -64,6 +64,12 @@ class ShardedTrainer:
     _compiled_step: Any = dataclasses.field(default=None, repr=False)
     precompile_timings: dict = dataclasses.field(default_factory=dict)
     last_used_aot: bool = False
+    # host wall-clock of the last step()/shard_batch() calls — the
+    # "compute (dispatch)" / "h2d" phases of the step timeline
+    # (obs/timeline.py), measured at the source so the loop's own
+    # bookkeeping never pollutes the attribution
+    last_step_dispatch_s: float = 0.0
+    last_shard_batch_s: float = 0.0
 
     def init(self, rng: jax.Array) -> TrainState:
         return self.init_fn(rng)
@@ -109,6 +115,15 @@ class ShardedTrainer:
         self._compiled_step = compiled
 
     def step(self, state: TrainState, tokens, targets):
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            return self._step_inner(state, tokens, targets)
+        finally:
+            self.last_step_dispatch_s = _time.monotonic() - t0
+
+    def _step_inner(self, state: TrainState, tokens, targets):
         if self._compiled_step is not None:
             try:
                 out = self._compiled_step(state, tokens, targets)
@@ -132,11 +147,16 @@ class ShardedTrainer:
     def shard_batch(self, tokens, targets):
         """Host numpy (global_batch, seq) → device arrays shaped
         (accum, micro, seq) with the micro axis over (data, fsdp)."""
+        import time as _time
+
+        t0 = _time.monotonic()
         accum, micro = self.accum_steps, self.micro_batch
         tokens = tokens.reshape(accum, micro, *tokens.shape[1:])
         targets = targets.reshape(accum, micro, *targets.shape[1:])
         put = lambda x: jax.device_put(x, self.batch_sharding)
-        return put(tokens), put(targets)
+        result = put(tokens), put(targets)
+        self.last_shard_batch_s = _time.monotonic() - t0
+        return result
 
 
 def build_trainer(

@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Render training diagnosis: reports + per-worker phase timelines.
+
+Three sources, one view:
+
+    # a live master (DiagnosisReportRequest RPC)
+    python tools/diagnose.py --master 10.0.0.2:50051 [--limit 20]
+
+    # a flight-recorder dump (the master's `diagnosis` events)
+    python tools/diagnose.py --flight /tmp/dlrover-tpu-flight/flight-master-7.json
+
+    # a worker's exported step timeline (obs/timeline.py ring)
+    python tools/diagnose.py --timeline /tmp/.../timeline.json [--last 10]
+
+Exit codes: 0 ok; 2 on unreadable inputs / unreachable master.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+_PHASE_ORDER = ("data_wait", "h2d", "compute", "host_sync",
+                "checkpoint", "other")
+
+
+def render_reports(reports: List[Dict[str, Any]]) -> str:
+    """One line per report, time-ordered relative to the first."""
+    lines = [f"diagnosis reports: {len(reports)}"]
+    if not reports:
+        return "\n".join(lines)
+    ordered = sorted(reports, key=lambda r: r.get("ts", 0.0))
+    t0 = ordered[0].get("ts", 0.0)
+    for report in ordered:
+        worker_id = int(report.get("worker_id", -1))
+        target = f"worker {worker_id}" if worker_id >= 0 else "job"
+        actions = ",".join(report.get("actions", [])) or "-"
+        lines.append(
+            "+{offset:8.1f}s  {severity:<8} {rule:<22} {target:<10} "
+            "{summary}  [{actions}]".format(
+                offset=report.get("ts", 0.0) - t0,
+                severity=str(report.get("severity", "?")),
+                rule=str(report.get("rule", "?")),
+                target=target,
+                summary=str(report.get("summary", "")),
+                actions=actions).rstrip())
+    return "\n".join(lines)
+
+
+def reports_from_flight(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Reconstruct report dicts from a flight dump's `diagnosis` events
+    (the master records one per emitted report)."""
+    reports = []
+    for record in payload.get("events", []):
+        if record.get("kind") != "event" or \
+                record.get("name") != "diagnosis":
+            continue
+        attrs = record.get("attrs", {})
+        reports.append({
+            "rule": attrs.get("rule", "?"),
+            "severity": attrs.get("severity", "?"),
+            "worker_id": attrs.get("worker", -1),
+            "summary": attrs.get("summary", ""),
+            "actions": attrs.get("actions", []),
+            "ts": record.get("ts", 0.0),
+        })
+    return reports
+
+
+def render_timeline(payload: Dict[str, Any], last: int = 0) -> str:
+    """Per-step phase breakdown + windowed summary of an exported ring."""
+    steps = payload.get("steps", [])
+    shown = steps[-last:] if last > 0 else steps
+    header = ("step timeline: role={role} rank={rank} steps={n}".format(
+        role=payload.get("role", "?"), rank=payload.get("rank", "?"),
+        n=len(steps)))
+    if last > 0 and len(steps) > last:
+        header += f" (showing last {len(shown)})"
+    lines = [header]
+    if not shown:
+        return "\n".join(lines)
+    total = sum(e.get("total_s", 0.0) for e in shown)
+    summary = [f"mean step {total / len(shown):.4f}s"]
+    if total > 0:
+        fractions = []
+        for phase in _PHASE_ORDER:
+            spent = sum(e.get("phases", {}).get(phase, 0.0)
+                        for e in shown)
+            if spent > 0:
+                fractions.append(f"{phase} {100.0 * spent / total:.0f}%")
+        if fractions:
+            summary.append(" ".join(fractions))
+    lines.append(" | ".join(summary))
+    lines.append("{:>8}  {:>9}  ".format("step", "total") + "  ".join(
+        f"{p:>10}" for p in _PHASE_ORDER))
+    for entry in shown:
+        phases = entry.get("phases", {})
+        lines.append(
+            "{:>8}  {:>8.4f}s  ".format(
+                entry.get("step", "?"), entry.get("total_s", 0.0))
+            + "  ".join(f"{phases.get(p, 0.0):>10.4f}"
+                        for p in _PHASE_ORDER))
+    return "\n".join(lines)
+
+
+def _load_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"{path}: unreadable: {e}", file=sys.stderr)
+        return None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "diagnose", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--master", default="",
+                        help="live master address (host:port)")
+    parser.add_argument("--flight", nargs="*", default=[],
+                        help="flight-recorder dump file(s)")
+    parser.add_argument("--timeline", nargs="*", default=[],
+                        help="exported worker timeline file(s)")
+    parser.add_argument("--limit", type=int, default=0,
+                        help="max reports from a live master (0 = all)")
+    parser.add_argument("--last", type=int, default=0,
+                        help="show only the last N timeline steps")
+    ns = parser.parse_args(argv)
+    if not (ns.master or ns.flight or ns.timeline):
+        parser.error("one of --master / --flight / --timeline is required")
+    status = 0
+    if ns.master:
+        try:
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            client = MasterClient(ns.master, node_id=-1)
+            try:
+                print(render_reports(
+                    client.get_diagnosis_reports(ns.limit)))
+            finally:
+                client.close()
+        except Exception as e:  # noqa: BLE001 — transport errors vary
+            print(f"master {ns.master}: unreachable: {e}", file=sys.stderr)
+            status = 2
+    for path in ns.flight:
+        payload = _load_json(path)
+        if payload is None:
+            status = 2
+            continue
+        print(f"== {path}")
+        print(render_reports(reports_from_flight(payload)))
+    for path in ns.timeline:
+        payload = _load_json(path)
+        if payload is None:
+            status = 2
+            continue
+        print(f"== {path}")
+        print(render_timeline(payload, ns.last))
+    return status
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
