@@ -29,15 +29,117 @@ from typing import Dict, List, Optional, Tuple
 import grpc
 
 from dlrover_tpu import obs
-from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.master_client import MasterClient, backoff_delay_s
+from dlrover_tpu.agent.preemption import (
+    PreemptionNotice,
+    PreemptionWatcher,
+    default_sources,
+    write_drain_request,
+)
 from dlrover_tpu.common.bootstrap import publish_or_wait_coordinator
 from dlrover_tpu.common.constants import (
     DefaultValues,
     NodeEnv,
+    NodeExitReason,
     RendezvousName,
     TrainingMsgLevel,
+    WorkerExit,
 )
 from dlrover_tpu.common.log import default_logger as logger
+
+
+class RelaunchGovernor:
+    """Per-rank relaunch pacing: exponential delay between worker
+    relaunches (base·2^(k−1) for the k-th recent failure, capped — no
+    jitter: one agent, one worker, nothing to de-synchronize) and
+    quarantine once ``quarantine_failures`` land inside
+    ``quarantine_window_s``. A flapping worker must not hot-loop
+    respawns. Driven only from the agent's main run loop — the same
+    single-writer contract as the worker process itself, so no lock.
+
+    Hang-aborts do not charge ``max_restarts``, so they need their own
+    loop-breaker the time window cannot provide (a watchdog cycle of a
+    few minutes never fits ``quarantine_failures`` aborts inside the
+    window): ``record_hang`` counts CONSECUTIVE hangs from incarnations
+    that made no forward progress. Progress is judged two ways — the
+    incarnation pushed the job's step high-water mark (the timeline
+    export the agent reads; re-treading checkpointed steps is NOT
+    forward progress), or it outlived the watchdog's warmup-plus-slack
+    horizon (the watchdog would have fired sooner otherwise). Either
+    one — on ANY death, hang or crash — resets the streak, so hangs
+    separated by productive incarnations never accumulate.
+    ``quarantine_failures`` no-progress hangs in a row quarantine the
+    rank regardless of how slowly they arrive."""
+
+    def __init__(self, clock=time.monotonic):
+        from collections import deque
+
+        from dlrover_tpu.common.config import Context
+        from dlrover_tpu.trainer.watchdog import default_warmup_s
+
+        ctx = Context.singleton()
+        self._base_s = ctx.relaunch_backoff_base_s
+        self._max_s = ctx.relaunch_backoff_max_s
+        self._quarantine_failures = ctx.quarantine_failures
+        self._window_s = ctx.quarantine_window_s
+        # the watchdog's own first-step budget plus 2·hang of slack: an
+        # incarnation alive past this has stepped even if the timeline
+        # export never landed
+        hang_s = ctx.hang_watchdog_s
+        self._hang_progress_horizon_s = (default_warmup_s(hang_s)
+                                         + 2.0 * hang_s)
+        self._consecutive_early_hangs = 0
+        self._clock = clock
+        self._failures = deque()
+
+    def _trim(self, now: float) -> None:
+        while self._failures and now - self._failures[0] > self._window_s:
+            self._failures.popleft()
+
+    def _note_progress(self, lifetime_s: float,
+                       made_progress: bool) -> None:
+        if (made_progress
+                or lifetime_s >= self._hang_progress_horizon_s):
+            self._consecutive_early_hangs = 0
+
+    def record_failure(self, lifetime_s: float = 0.0,
+                       made_progress: bool = False) -> float:
+        """Register one worker failure (any kind); returns the backoff
+        delay to apply before the relaunch. A productive incarnation —
+        stepped past the job high-water mark, or simply long-lived —
+        breaks the no-progress hang streak even when it ends in a
+        crash: its hangs were never 'consecutive'."""
+        self._note_progress(lifetime_s, made_progress)
+        now = self._clock()
+        self._trim(now)
+        self._failures.append(now)
+        exponent = min(len(self._failures) - 1, 62)
+        return min(self._max_s, self._base_s * (2.0 ** exponent))
+
+    def record_hang(self, lifetime_s: float,
+                    made_progress: bool = False) -> None:
+        """Register a watchdog hang-abort. Counts toward the streak
+        only when the incarnation made NO forward progress — a worker
+        that advanced the job before wedging is a flaky collective,
+        not a deterministic hang loop."""
+        if (made_progress
+                or lifetime_s >= self._hang_progress_horizon_s):
+            self._consecutive_early_hangs = 0
+        else:
+            self._consecutive_early_hangs += 1
+
+    @property
+    def recent_failures(self) -> int:
+        self._trim(self._clock())
+        return len(self._failures)
+
+    @property
+    def quarantined(self) -> bool:
+        if self._quarantine_failures <= 0:
+            return False
+        return (self.recent_failures >= self._quarantine_failures
+                or (self._consecutive_early_hangs
+                    >= self._quarantine_failures))
 
 
 @dataclasses.dataclass
@@ -88,6 +190,12 @@ class MasterLostError(RuntimeError):
     """The master stayed unreachable past the reconnect budget."""
 
 
+class PreemptedDuringOutage(Exception):
+    """A preemption notice arrived while the agent was in master-lost
+    reconnect: the reconnect is abandoned so the grace window goes to
+    the local emergency checkpoint, not to dialing a dead master."""
+
+
 class ElasticAgent:
     """Joins the master rendezvous and keeps one training process alive."""
 
@@ -121,6 +229,28 @@ class ElasticAgent:
             self._workdir, "profile_request.json")
         self.profile_dump_dir = os.path.join(self._workdir, "profiles")
         self._profile_request_seq = 0
+        # preemption drain plumbing: the notice file chaos/platform
+        # hooks write (PreemptionWatcher polls it; honored from env so
+        # a platform hook outside this agent can name the path), and
+        # the drain request the worker's step loop consumes
+        self.preempt_notice_file = os.environ.get(
+            NodeEnv.PREEMPTION_NOTICE_FILE,
+            os.path.join(self._workdir, "preempt_notice.json"))
+        self.drain_request_file = os.path.join(
+            self._workdir, "drain_request.json")
+        self._drain_seq = 0
+        # set by the PreemptionWatcher thread; consumed only by the main
+        # run loop (same contract as _hang_event)
+        self._preempt_notice: Optional[PreemptionNotice] = None
+        self._preempt_event = threading.Event()
+        self._preempt_watcher: Optional[PreemptionWatcher] = None
+        # relaunch pacing: backoff between respawns, quarantine on flap
+        self._governor = RelaunchGovernor()
+        self._spawn_ts = time.monotonic()
+        # the job's step high-water mark at spawn (from the timeline
+        # export): an incarnation that pushes past it made FORWARD
+        # progress — re-treading checkpointed steps does not count
+        self._spawn_step = -1
         # Persistent XLA compile cache shared across worker restarts: an
         # elastic restart re-lowers the same programs, so the respawned
         # worker skips compilation — the dominant cost of a fast restore.
@@ -197,6 +327,11 @@ class ElasticAgent:
             NodeEnv.PARAL_CONFIG_PATH: self.paral_config_file,
             NodeEnv.TIMELINE_FILE: self.timeline_file,
             NodeEnv.PROFILE_REQUEST_FILE: self.profile_request_file,
+            NodeEnv.DRAIN_REQUEST_FILE: self.drain_request_file,
+            # the worker sees the same notice path the agent polls, so
+            # the chaos `preempt` fault (running in the worker's step
+            # loop) can deliver a notice to THIS agent deterministically
+            NodeEnv.PREEMPTION_NOTICE_FILE: self.preempt_notice_file,
         })
         env.setdefault("JAX_COMPILATION_CACHE_DIR", self.compile_cache_dir)
         return env
@@ -211,6 +346,8 @@ class ElasticAgent:
             self._spec.entrypoint,
         )
         self._proc = subprocess.Popen(self._spec.entrypoint, env=env)
+        self._spawn_ts = time.monotonic()
+        self._spawn_step = self._timeline_step()
         obs.get_flight_recorder().record_event(
             "worker_spawn", round=rdzv_round, world=sorted(world),
             restart=self._restart_count, pid=self._proc.pid)
@@ -292,6 +429,12 @@ class ElasticAgent:
         """Monitor loop (reference: _invoke_run training.py:429-521).
         Returns the worker's final exit code."""
         recorder = obs.get_flight_recorder()
+        # ORDER MATTERS: the drain SIGTERM source installs first, the
+        # recorder's dump handler second — the recorder chains to its
+        # predecessor, so one SIGTERM yields BOTH the flight dump and
+        # the drain notice (and nobody re-raises the default kill: the
+        # notice is the graceful alternative to dying now)
+        self._start_preemption_watcher()
         if threading.current_thread() is threading.main_thread():
             # postmortem timeline even when the platform SIGTERMs the
             # agent itself (signal API is main-thread-only)
@@ -300,7 +443,10 @@ class ElasticAgent:
         self._spawn()
         self._start_monitors()
         try:
-            return self._run_loop()
+            # normalize at the process boundary: a worker code this
+            # agent re-exits with must be POSIX-shaped (134, not -6) or
+            # the pod-side classification can never recognize it
+            return WorkerExit.to_exit_status(self._run_loop())
         except BaseException:
             # master-lost (and only master-lost) paths can raise with a
             # LIVE worker — never orphan the trainer on the way out
@@ -308,6 +454,8 @@ class ElasticAgent:
             raise
         finally:
             self._stop_monitors()
+            if self._preempt_watcher is not None:
+                self._preempt_watcher.stop()
             self._flush_telemetry()
             obs.remove_span_sink(self._span_exporter)
             recorder.dump(reason="agent-exit")
@@ -315,12 +463,26 @@ class ElasticAgent:
     def _flush_telemetry(self) -> None:
         self._span_exporter.flush_to(self._client)
 
+    def _interruptible_wait(self, delay_s: float) -> None:
+        """Sleep up to ``delay_s``, returning early on shutdown or a
+        preemption notice — every sleep on the agent's main loop sits
+        inside the grace window, and the window is short."""
+        end = time.monotonic() + delay_s
+        while (time.monotonic() < end
+               and not self._shutdown.is_set()
+               and not self._preempt_event.is_set()):
+            time.sleep(min(0.2, max(0.0, end - time.monotonic())))
+
     def _run_loop(self) -> int:
         spec = self._spec
         while True:
-            time.sleep(spec.monitor_interval_s)
+            self._interruptible_wait(spec.monitor_interval_s)
             if self._shutdown.is_set():
                 return 0
+            # a preemption notice outranks everything: this host is
+            # going away — drain instead of monitoring
+            if self._preempt_event.is_set():
+                return self._drain(self._preempt_notice)
             self._flush_telemetry()
             code = self._proc.poll()
             if code is not None:
@@ -329,30 +491,17 @@ class ElasticAgent:
                 if code == 0:
                     logger.info("worker finished successfully")
                     return 0
-                obs.get_flight_recorder().record_event(
-                    "worker_failed", exit_code=code,
-                    restart=self._restart_count)
-                try:
-                    self._client.report_failure(
-                        f"worker exit code {code}",
-                        level=TrainingMsgLevel.PROCESS_ERROR,
-                        restart_count=self._restart_count,
-                    )
-                except Exception:  # master down: the restart path's own
-                    # rendezvous will surface a persistent outage
-                    logger.warning("could not report worker failure "
-                                   "(master unreachable)")
-                if self._restart_count >= spec.max_restarts:
-                    logger.error(
-                        "worker failed (exit %d) with restart budget "
-                        "exhausted (%d)", code, spec.max_restarts,
-                    )
-                    return code
-                logger.warning(
-                    "worker failed (exit %d); restarting (%d/%d)",
-                    code, self._restart_count + 1, spec.max_restarts,
-                )
-                self._restart_worker_resilient(count_against_budget=True)
+                kind = WorkerExit.classify(
+                    code, hang_enabled=self._hang_watchdog_enabled())
+                if kind == NodeExitReason.DRAINED:
+                    # the worker drained itself (its own SIGTERM path or
+                    # a notice the agent never saw): clean departure —
+                    # no failure report, no relaunch charge
+                    return self._conclude_drain(code, deadline=0.0,
+                                                reason="worker-initiated")
+                outcome = self._handle_worker_failure(code, kind)
+                if outcome is not None:
+                    return outcome
                 continue
             # Hang flagged by the detector thread: restart HERE so only
             # the main loop ever touches the worker process.
@@ -389,6 +538,214 @@ class ElasticAgent:
                     "membership_restart", waiting=waiting)
                 self._restart_worker_resilient(count_against_budget=False)
 
+    # -- failure classification / relaunch pacing --------------------------
+    def _timeline_step(self) -> int:
+        """The job's step high-water mark from the worker's timeline
+        export (-1 when absent/corrupt — readers poll mid-flight)."""
+        from dlrover_tpu.obs.timeline import load_timeline
+
+        payload = load_timeline(self.timeline_file)
+        if payload is None:
+            return -1
+        steps = (int(s.get("step", -1)) for s in payload["steps"]
+                 if isinstance(s, dict))
+        return max(steps, default=-1)
+
+    def _handle_worker_failure(self, code: int, kind: str
+                               ) -> Optional[int]:
+        """One classified worker failure: report it, pace the relaunch
+        (backoff + quarantine), restart. Returns a terminal exit code,
+        or None when the worker was restarted and the loop continues."""
+        spec = self._spec
+        recorder = obs.get_flight_recorder()
+        lifetime_s = time.monotonic() - self._spawn_ts
+        # forward progress = the incarnation pushed the job's step
+        # high-water mark; a respawn hanging before it re-reaches the
+        # previous mark is exactly the no-progress loop quarantine is
+        # for, so re-treading restored steps deliberately doesn't count
+        made_progress = self._timeline_step() > self._spawn_step
+        recorder.record_event("worker_failed", exit_code=code, kind=kind,
+                              restart=self._restart_count)
+        if kind == NodeExitReason.HANG:
+            recorder.record_event("worker_hang_abort", exit_code=code)
+        try:
+            self._client.report_failure(
+                f"worker exit code {code}",
+                level=TrainingMsgLevel.PROCESS_ERROR,
+                restart_count=self._restart_count,
+                exit_kind=kind,
+            )
+        except Exception:  # master down: the restart path's own
+            # rendezvous will surface a persistent outage
+            logger.warning("could not report worker failure "
+                           "(master unreachable)")
+        # a watchdog hang-abort is the backstop doing its job, not a
+        # worker defect: restart without charging max_restarts (parity
+        # with the HangingDetector path) — the governor's consecutive
+        # no-progress-hang count quarantines a deterministic hang loop
+        # the time window alone could never catch
+        counts = kind != NodeExitReason.HANG
+        if not counts:
+            self._governor.record_hang(lifetime_s,
+                                       made_progress=made_progress)
+        if counts and self._restart_count >= spec.max_restarts:
+            logger.error(
+                "worker failed (exit %d, %s) with restart budget "
+                "exhausted (%d)", code, kind, spec.max_restarts,
+            )
+            return code
+        delay = self._governor.record_failure(
+            lifetime_s, made_progress=made_progress)
+        registry = obs.get_registry()
+        registry.gauge(
+            "dlrover_tpu_agent_relaunch_backoff_seconds",
+            "Backoff applied before the most recent worker relaunch",
+        ).set(delay)
+        if self._governor.quarantined:
+            registry.gauge(
+                "dlrover_tpu_agent_quarantined",
+                "1 while this agent's rank is quarantined "
+                "(relaunches stopped after repeated failures)").set(1)
+            recorder.record_event(
+                "worker_quarantined", exit_code=code, kind=kind,
+                recent_failures=self._governor.recent_failures)
+            logger.error(
+                "worker QUARANTINED: %d failures inside the window; "
+                "refusing to relaunch (exit %d)",
+                self._governor.recent_failures, code)
+            try:
+                self._client.report_failure(
+                    f"rank quarantined after "
+                    f"{self._governor.recent_failures} failures",
+                    level=TrainingMsgLevel.NODE_ERROR,
+                    restart_count=self._restart_count,
+                    exit_kind=kind,
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            return code
+        if delay > 0:
+            recorder.record_event("relaunch_backoff", delay_s=delay,
+                                  recent_failures=(
+                                      self._governor.recent_failures))
+            logger.warning(
+                "worker failed (exit %d, %s); backing off %.1fs before "
+                "relaunch (%d recent failures)", code, kind, delay,
+                self._governor.recent_failures)
+            self._interruptible_wait(delay)
+            if self._shutdown.is_set():
+                return 0
+            # a preemption notice mid-backoff outranks the relaunch:
+            # sleeping through it would eat the grace window and the
+            # respawn would die with the VM anyway — drain instead
+            if self._preempt_event.is_set():
+                logger.warning(
+                    "preemption notice during relaunch backoff; "
+                    "draining instead of respawning")
+                return self._drain(self._preempt_notice)
+        logger.warning(
+            "worker failed (exit %d, %s); restarting (%d/%d)",
+            code, kind, self._restart_count + (1 if counts else 0),
+            spec.max_restarts,
+        )
+        self._restart_worker_resilient(count_against_budget=counts)
+        return None
+
+    # -- preemption drain --------------------------------------------------
+    def _start_preemption_watcher(self) -> None:
+        def _on_notice(notice: PreemptionNotice) -> None:
+            # watcher thread: only flip the event — the main run loop
+            # owns the worker process and every RPC
+            self._preempt_notice = notice
+            self._preempt_event.set()
+
+        self._preempt_watcher = PreemptionWatcher(
+            _on_notice,
+            sources=default_sources(notice_file=self.preempt_notice_file))
+        self._preempt_watcher.start()
+
+    def _drain(self, notice: PreemptionNotice) -> int:
+        """The graceful exit: announce the drain, hand the worker a
+        deadline-bounded save-and-exit request, await the clean-drain
+        exit (force-stopping at the deadline — the VM dies then anyway),
+        conclude with the master. Always a NON-failure: no relaunch
+        charge, no failure report."""
+        recorder = obs.get_flight_recorder()
+        deadline = notice.deadline
+        recorder.record_event(
+            "preempt_notice", rank=self._client.node_rank,
+            deadline=deadline, grace_s=round(notice.grace_s, 1),
+            source=notice.source, reason=notice.reason[:256])
+        obs.get_registry().counter(
+            "dlrover_tpu_agent_preempt_notices_total",
+            "Preemption notices this agent acted on",
+            labelnames=("source",)).labels(source=notice.source).inc()
+        with obs.span("drain", {"rank": self._client.node_rank,
+                                "source": notice.source}) as drain_span:
+            # the worker's drain request goes out FIRST: against an
+            # unreachable master the announce below burns its whole RPC
+            # retry budget, and every second of that comes out of the
+            # grace window — the emergency checkpoint must already be
+            # running by then
+            self._drain_seq += 1
+            write_drain_request(self.drain_request_file, self._drain_seq,
+                                deadline, reason=notice.reason,
+                                exit_worker=True)
+            try:
+                result = self._client.report_drain(
+                    deadline, reason=notice.reason, phase="notice")
+                logger.info(
+                    "drain announced to the master (urgent checkpoint "
+                    "fanned out to ranks %s)", result.checkpoint_ranks)
+            except Exception:  # noqa: BLE001 — master down: the local
+                # emergency checkpoint matters more than the announce
+                logger.warning("could not announce drain to the master; "
+                               "draining locally anyway")
+            code = self._await_worker_departure(deadline)
+            drain_span.set_attr("exit_code", code)
+        return self._conclude_drain(code, deadline, notice.reason)
+
+    def _await_worker_departure(self, deadline: float) -> int:
+        """Poll the worker until it exits or the deadline lands; a
+        worker that ignored the drain request (not running the elastic
+        loop, or wedged) is force-stopped — better a SIGTERM save than
+        the platform's SIGKILL a moment later."""
+        while time.time() < deadline:
+            if self._shutdown.is_set():
+                break
+            code = self._proc.poll() if self._proc is not None else 0
+            if code is not None:
+                return code
+            time.sleep(0.2)
+        logger.warning("worker still running at the drain deadline; "
+                       "force-stopping")
+        self._stop_worker()
+        return (self._proc.returncode
+                if self._proc is not None else 0)
+
+    def _hang_watchdog_enabled(self) -> bool:
+        from dlrover_tpu.common.config import Context
+        return Context.singleton().hang_watchdog_s > 0
+
+    def _conclude_drain(self, code: int, deadline: float,
+                        reason: str) -> int:
+        kind = WorkerExit.classify(
+            code, hang_enabled=self._hang_watchdog_enabled())
+        clean = kind in (NodeExitReason.DRAINED,
+                         NodeExitReason.SUCCEEDED)
+        obs.get_flight_recorder().record_event(
+            "worker_drained", exit_code=code, kind=kind, clean=clean,
+            reason=reason[:256])
+        try:
+            self._client.report_drain(deadline, reason=reason,
+                                      phase="complete")
+        except Exception:  # noqa: BLE001 — the blown-deadline reap on
+            # the master is the fallback when this RPC is lost
+            logger.warning("could not report drain completion")
+        logger.info("drain complete (worker exit %d, %s): agent "
+                    "departing", code, kind)
+        return 0 if clean else code
+
     # -- diagnosis actions -------------------------------------------------
     def _poll_diagnosis_actions(self) -> None:
         """Drain and execute the master's diagnosis actions for this
@@ -417,6 +774,8 @@ class ElasticAgent:
             labelnames=("kind",)).labels(kind=kind).inc()
         if kind == "profile":
             self._request_profile(action)
+        elif kind == "checkpoint":
+            self._request_checkpoint(action)
         elif kind == "restart":
             logger.warning("diagnosis: restarting worker (%s)", reason)
             self._restart_worker_resilient(count_against_budget=False)
@@ -439,6 +798,25 @@ class ElasticAgent:
             "diagnosis: requested a %d-step profiler capture (#%d) -> %s",
             num_steps, self._profile_request_seq, self.profile_dump_dir)
 
+    def _request_checkpoint(self, action: dict) -> None:
+        """A master `checkpoint:{rank}` action (a peer is draining):
+        hand the worker a save-now-KEEP-RUNNING request through the
+        drain file — the step loop saves at its next boundary."""
+        from dlrover_tpu.common.config import Context
+
+        self._drain_seq += 1
+        deadline = float(action.get("deadline", 0.0) or 0.0)
+        if deadline <= 0.0:
+            deadline = (time.time()
+                        + Context.singleton().preempt_default_grace_s)
+        write_drain_request(
+            self.drain_request_file, self._drain_seq, deadline,
+            reason=str(action.get("reason", "")), exit_worker=False)
+        logger.info(
+            "diagnosis: urgent checkpoint requested of the worker "
+            "(#%d, deadline in %.0fs)", self._drain_seq,
+            max(0.0, deadline - time.time()))
+
     # -- master failover ---------------------------------------------------
     def _handle_master_loss(self) -> None:
         """Degraded "master lost" mode. The worker keeps training — it
@@ -449,7 +827,6 @@ class ElasticAgent:
         re-syncs rendezvous state, restarting the worker only when the
         world actually moved on. Raises MasterLostError once
         master_reconnect_timeout_s is exhausted."""
-        from dlrover_tpu.agent.master_client import backoff_delay_s
         from dlrover_tpu.common.config import Context
 
         ctx = Context.singleton()
@@ -465,7 +842,18 @@ class ElasticAgent:
             "dlrover_tpu_master_lost_total",
             "Master-lost episodes entered by this agent").inc()
         while True:
-            result = self._reconnect_master(ctx, recorder)
+            try:
+                result = self._reconnect_master(ctx, recorder)
+            except PreemptedDuringOutage:
+                # this host is going away: every second spent dialing
+                # the dead master comes out of the emergency-checkpoint
+                # window. Return to the run loop, whose next tick
+                # consumes the preempt event and drains locally (the
+                # drain path already tolerates an unreachable master).
+                logger.warning(
+                    "preemption notice during master-lost reconnect; "
+                    "abandoning the reconnect to drain locally")
+                return
             try:
                 self._resync_rendezvous(result)
                 return
@@ -488,6 +876,8 @@ class ElasticAgent:
         while True:
             if self._shutdown.is_set():
                 raise MasterLostError("agent shut down mid-reconnect")
+            if self._preempt_event.is_set():
+                raise PreemptedDuringOutage()
             addr = self._client.resolve_master_addr(
                 self._client.master_addr)
             try:
@@ -517,7 +907,10 @@ class ElasticAgent:
                 logger.warning(
                     "master still unreachable at %s (attempt %d): %s; "
                     "next dial in %.1fs", addr, attempt, exc, delay)
-                time.sleep(delay)
+                # a preemption notice (or a shutdown) mid-sleep must
+                # not wait out the full delay — the grace window is
+                # shorter than rpc_backoff_max_s
+                self._interruptible_wait(delay)
                 continue
             logger.info(
                 "reconnected to master %s (generation %d, world "
@@ -559,6 +952,8 @@ class ElasticAgent:
     def shutdown(self) -> None:
         self._shutdown.set()
         self._stop_monitors()
+        if self._preempt_watcher is not None:
+            self._preempt_watcher.stop()
         self._stop_worker()
         obs.remove_span_sink(self._span_exporter)
 

@@ -359,12 +359,25 @@ class MasterClient:
             timestamp=time.time(), node_rank=self.node_rank)).success
 
     def report_failure(self, error_data: str, level: str,
-                       restart_count: int = 0) -> bool:
+                       restart_count: int = 0,
+                       exit_kind: str = "") -> bool:
         return self._report(msg.NodeFailureReport(
             node_id=self.node_id, node_rank=self.node_rank,
             error_data=error_data, level=level,
-            restart_count=restart_count,
+            restart_count=restart_count, exit_kind=exit_kind,
         )).success
+
+    @retry_rpc(retries=3)
+    def report_drain(self, deadline: float, reason: str = "",
+                     phase: str = "notice") -> msg.DrainResult:
+        """Announce (phase="notice") or conclude (phase="complete") this
+        node's preemption drain. A modest retry budget: the drain window
+        is finite — better to proceed with the local emergency
+        checkpoint than to spend the grace period retrying RPCs."""
+        return self._report_typed(msg.DrainReport(
+            node_id=self.node_id, node_rank=self.node_rank,
+            deadline=deadline, reason=reason, phase=phase,
+        ), msg.DrainResult)
 
     def report_node_address(self, addr: str) -> bool:
         return self._report(msg.NodeAddressReport(

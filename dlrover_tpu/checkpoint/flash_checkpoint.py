@@ -92,6 +92,10 @@ class FlashCheckpointer:
             item_names=(_MODEL_ITEM, _DATA_ITEM),
         )
         self._lock = threading.Lock()
+        # wall-clock of the last full (dispatch + commit) save, the
+        # emergency path's estimate of whether a deadline is winnable;
+        # 0 = no evidence yet (guarded by _lock)
+        self._last_full_save_s = 0.0
 
     # ------------------------------------------------------------------
     def maybe_save(self, step: int, state: Any,
@@ -146,6 +150,107 @@ class FlashCheckpointer:
             logger.info("flash checkpoint: async save started at step %d",
                         step)
         return saved
+
+    def save_emergency(self, step: int, state: Any,
+                       data_state: Optional[Dict[str, Any]] = None,
+                       deadline: float = 0.0,
+                       min_window_s: Optional[float] = None) -> str:
+        """Deadline-bounded save on the way out (preemption drain): the
+        VM disappears at ``deadline`` (unix ts), so the save must COMMIT
+        before then or not start at all. Returns the outcome:
+
+        - ``"saved"``   — dispatched and committed inside the window;
+        - ``"skipped"`` — window too small (below ``min_window_s``, or
+          below the last observed full-save wall time): a save that
+          cannot commit only produces a torn step the restore fallback
+          then has to walk past — skip loudly instead;
+        - ``"timeout"`` — dispatched but the commit did not finish in
+          time; the step MAY be torn (the restore fallback handles it),
+          logged as such;
+        - ``"noop"``    — nothing dispatched (Orbax declined the save).
+
+        Counted in ``dlrover_tpu_checkpoint_emergency_total{outcome}``.
+        """
+        import time as _time
+
+        if min_window_s is None:
+            from dlrover_tpu.common.config import Context
+
+            min_window_s = Context.singleton().emergency_ckpt_min_window_s
+        now = _time.time()
+        remaining = deadline - now if deadline > 0 else float("inf")
+        with self._lock:
+            estimate = self._last_full_save_s
+        if remaining < max(min_window_s, estimate):
+            logger.error(
+                "emergency checkpoint at step %d SKIPPED: %.1fs left "
+                "before the deadline (< floor %.1fs / last full save "
+                "%.1fs) — resume will fall back to the last committed "
+                "step", step, remaining, min_window_s, estimate)
+            outcome = "skipped"
+        else:
+            t0 = _time.monotonic()
+            with obs.span("emergency_checkpoint",
+                          {"step": step,
+                           "window_s": round(min(remaining, 1e9), 1)}
+                          ) as em_span:
+                # an interval save may already be in flight for this
+                # very step (drain landing on a boundary); re-saving
+                # the step would make Orbax refuse — just await it
+                if self.latest_step() == step:
+                    saved = True
+                    dispatched = False
+                else:
+                    saved = self.maybe_save(step, state, data_state,
+                                            force=True)
+                    dispatched = saved
+                if not saved:
+                    outcome = "noop"
+                else:
+                    # bounded commit wait: Orbax has no timeout, so park
+                    # the join on a side thread and give it what's left
+                    # of the window (minus a margin to exit cleanly)
+                    waiter = threading.Thread(
+                        target=self._wait_quietly, daemon=True)
+                    waiter.start()
+                    budget = (max(0.5, deadline - _time.time() - 0.5)
+                              if deadline > 0 else None)
+                    waiter.join(budget)
+                    if waiter.is_alive():
+                        outcome = "timeout"
+                        logger.error(
+                            "emergency checkpoint at step %d: commit "
+                            "still running at the deadline — the step "
+                            "may be torn (restore falls back past it)",
+                            step)
+                    else:
+                        outcome = "saved"
+                        # only a save THIS call dispatched measures a
+                        # full save — the await-in-flight branch would
+                        # record just the residual commit tail and
+                        # poison the skip-floor estimate
+                        if dispatched:
+                            with self._lock:
+                                self._last_full_save_s = (
+                                    _time.monotonic() - t0)
+                em_span.set_attr("outcome", outcome)
+        obs.get_registry().counter(
+            "dlrover_tpu_checkpoint_emergency_total",
+            "Deadline-bounded emergency saves by outcome",
+            labelnames=("outcome",)).labels(outcome=outcome).inc()
+        obs.get_flight_recorder().record_event(
+            "emergency_checkpoint", step=step, outcome=outcome,
+            window_s=round(min(remaining, 1e9), 1))
+        if outcome == "saved":
+            logger.info("emergency checkpoint committed at step %d "
+                        "(%.1fs window)", step, remaining)
+        return outcome
+
+    def _wait_quietly(self) -> None:
+        try:
+            self._manager.wait_until_finished()
+        except Exception:  # noqa: BLE001 — the drain path must not die
+            logger.exception("emergency checkpoint commit failed")
 
     def restore(self, abstract_state: Any
                 ) -> Optional[Tuple[Any, Dict[str, Any], int]]:

@@ -99,6 +99,33 @@ class Context:
         self.seconds_per_scale_check: float = (
             DefaultValues.SECONDS_PER_SCALE_CHECK
         )
+        # preemption-aware graceful drain (agent/preemption.py) + the
+        # deadline-bounded emergency checkpoint (checkpoint/, trainer/)
+        self.preempt_default_grace_s: float = (
+            DefaultValues.PREEMPT_DEFAULT_GRACE_S
+        )
+        self.preempt_notice_poll_s: float = (
+            DefaultValues.PREEMPT_NOTICE_POLL_S
+        )
+        self.preempt_env_horizon_s: float = (
+            DefaultValues.PREEMPT_ENV_HORIZON_S
+        )
+        self.emergency_ckpt_min_window_s: float = (
+            DefaultValues.EMERGENCY_CKPT_MIN_WINDOW_S
+        )
+        # step-hang watchdog (trainer/watchdog.py); 0 = disabled
+        self.hang_watchdog_s: float = DefaultValues.HANG_WATCHDOG_S
+        # per-rank relaunch backoff + quarantine (agent/elastic_agent.py)
+        self.relaunch_backoff_base_s: float = (
+            DefaultValues.RELAUNCH_BACKOFF_BASE_S
+        )
+        self.relaunch_backoff_max_s: float = (
+            DefaultValues.RELAUNCH_BACKOFF_MAX_S
+        )
+        self.quarantine_failures: int = DefaultValues.QUARANTINE_FAILURES
+        self.quarantine_window_s: float = (
+            DefaultValues.QUARANTINE_WINDOW_S
+        )
         self.relaunch_on_worker_failure: bool = True
         self.auto_scale_enabled: bool = False
         self.network_check_enabled: bool = False

@@ -57,12 +57,65 @@ class NodeEventType:
 
 class NodeExitReason:
     SUCCEEDED = "succeeded"
-    KILLED = "killed"            # deleted/preempted by the platform
+    KILLED = "killed"            # deleted/force-killed by the platform
+    # clean graceful drain (advance preemption notice honored: emergency
+    # checkpoint completed, worker exited WorkerExit.DRAIN) — a planned
+    # departure, not a failure: no relaunch-budget charge
+    DRAINED = "drained"
+    # self-aborted by the step-hang watchdog (stacks in the flight dump)
+    HANG = "hang"
     OOM = "oom"                  # host or HBM out-of-memory
     FATAL_ERROR = "fatal_error"  # un-relaunchable user error
     HARDWARE_ERROR = "hardware_error"  # TPU chip / ICI fault
     UNKNOWN_ERROR = "unknown_error"
     RELAUNCHED = "relaunched"
+
+
+class WorkerExit:
+    """Worker exit-code vocabulary shared by the trainer (producer), the
+    agent (classifier) and the k8s watcher (pod exit parsing)."""
+
+    SUCCESS = 0
+    # graceful drain after a preemption notice: the loop consumed the
+    # drain request, ran the deadline-bounded emergency checkpoint and
+    # exited clean. Chosen outside the shell (126/127) and signal
+    # (128+n) ranges.
+    DRAIN = 76
+    # SIGABRT: the step-hang watchdog self-aborts so the agent restarts
+    # the worker; Popen reports -6, k8s containers 128+6
+    _SIGABRT_POPEN = -6
+    _SIGABRT_SHELL = 134
+    # platform SIGKILL/SIGTERM (eviction, force delete)
+    _KILL_CODES = (-9, -15, 137, 143)
+
+    @classmethod
+    def classify(cls, code: int, hang_enabled: bool = True) -> str:
+        """Exit code → NodeExitReason.* (the agent/diagnosis layer must
+        tell drain from hang from crash from platform kill).
+
+        ``hang_enabled``: with the step-hang watchdog off
+        (``Context.hang_watchdog_s == 0``) a SIGABRT cannot be the
+        watchdog — it is an ordinary crash (glibc abort, C++ terminate)
+        and must charge the relaunch budget like one.
+        """
+        if code == cls.SUCCESS:
+            return NodeExitReason.SUCCEEDED
+        if code == cls.DRAIN:
+            return NodeExitReason.DRAINED
+        if code in (cls._SIGABRT_POPEN, cls._SIGABRT_SHELL):
+            return (NodeExitReason.HANG if hang_enabled
+                    else NodeExitReason.UNKNOWN_ERROR)
+        if code in cls._KILL_CODES:
+            return NodeExitReason.KILLED
+        return NodeExitReason.UNKNOWN_ERROR
+
+    @classmethod
+    def to_exit_status(cls, code: int) -> int:
+        """Popen's negative signal codes → the POSIX 128+N exit status
+        a container reports. An agent re-exiting its worker's code must
+        normalize, or -6 truncates to 250 at the process boundary and
+        the pod-side classification can never see the hang/kill."""
+        return 128 - code if code < 0 else code
 
 
 class JobStage:
@@ -108,6 +161,17 @@ class NodeEnv:
     # (obs/profiler.py; the agent writes it when executing a master
     # `profile:{rank}` diagnosis action)
     PROFILE_REQUEST_FILE = "DLROVER_TPU_PROFILE_REQUEST"
+    # agent → worker handoff: drain/checkpoint requests the step loop
+    # polls (agent/preemption.py write_drain_request; the agent writes
+    # it on a preemption notice — save+exit — or when executing a
+    # master `checkpoint:{rank}` action — save+continue)
+    DRAIN_REQUEST_FILE = "DLROVER_TPU_DRAIN_REQUEST"
+    # platform/chaos → agent: a preemption-notice file the agent's
+    # PreemptionWatcher polls ({"deadline": ts} or {"grace_s": n})
+    PREEMPTION_NOTICE_FILE = "DLROVER_TPU_PREEMPTION_NOTICE"
+    # k8s-style static notice: a unix timestamp set at pod creation
+    # ("this VM goes away at T" — maintenance windows, spot reclaim)
+    PREEMPTION_AT = "DLROVER_TPU_PREEMPTION_AT"
 
 
 class TrainingMsgLevel:
@@ -216,3 +280,33 @@ class DefaultValues:
     # per-rank cooldown between dispatched actions (a straggler that
     # stays slow must not get a profile request every interval)
     DIAGNOSIS_ACTION_COOLDOWN_S = 300.0
+    # -- preemption-aware graceful drain (agent/preemption.py) ----------
+    # grace window assumed when a notice carries no deadline (a bare
+    # SIGTERM): k8s default terminationGracePeriodSeconds
+    PREEMPT_DEFAULT_GRACE_S = 30.0
+    # cadence of the agent's notice-source poll (file/env sources)
+    PREEMPT_NOTICE_POLL_S = 1.0
+    # how far ahead of a static env deadline ($DLROVER_TPU_PREEMPTION_AT)
+    # the drain fires; 0 = use preempt_default_grace_s. Jobs whose full
+    # save takes longer than the bare-SIGTERM grace must widen this or
+    # the emergency save is skipped despite hours of advance notice.
+    PREEMPT_ENV_HORIZON_S = 0.0
+    # emergency checkpoint: skip-and-log when the remaining window is
+    # below this floor (a save that cannot commit only produces a torn
+    # step the restore fallback then has to walk past)
+    EMERGENCY_CKPT_MIN_WINDOW_S = 2.0
+    # -- step-hang watchdog (trainer/watchdog.py) -----------------------
+    # no step progress for this long → dump all-thread stacks + the
+    # flight record and self-abort so the agent restarts the worker.
+    # 0 = disabled (the default: legitimate step times vary too much to
+    # pick a universal bound; jobs opt in via DLROVER_TPU_HANG_WATCHDOG_S)
+    HANG_WATCHDOG_S = 0.0
+    # -- per-rank relaunch backoff + quarantine (agent) -----------------
+    # exponential delay between worker relaunches: base * 2^(k-1) for the
+    # k-th recent failure, capped — a flapping worker must not hot-loop
+    RELAUNCH_BACKOFF_BASE_S = 1.0
+    RELAUNCH_BACKOFF_MAX_S = 60.0
+    # quarantine the rank (stop relaunching; agent exits with the worker
+    # code) after this many failures inside the window; 0 disables
+    QUARANTINE_FAILURES = 5
+    QUARANTINE_WINDOW_S = 600.0

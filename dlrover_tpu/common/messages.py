@@ -321,6 +321,38 @@ class NodeFailureReport(Message):
     error_data: str = ""
     level: str = ""              # TrainingMsgLevel.*
     restart_count: int = 0
+    # NodeExitReason.* classification of the worker exit (WorkerExit.
+    # classify): the diagnosis layer must tell hang from crash from
+    # drain. "" = sender predates the field.
+    exit_kind: str = ""
+
+
+@dataclass
+class DrainReport(Message):
+    """The advance-notice drain protocol (agent → master).
+
+    phase="notice": this node received a preemption notice and is
+    draining — it will emergency-checkpoint and depart by ``deadline``
+    (unix ts). The master marks the rank DRAINING, fans out urgent
+    ``checkpoint`` actions and pre-plans the post-departure world.
+
+    phase="complete": the worker exited with the clean-drain code; the
+    master removes the rank NOW (planned departure) so survivors re-form
+    in one round instead of waiting out the liveness timeout."""
+
+    node_id: int = -1
+    node_rank: int = -1
+    deadline: float = 0.0        # unix ts the VM disappears at
+    reason: str = ""             # notice source / chaos tag
+    phase: str = "notice"        # "notice" | "complete"
+
+
+@dataclass
+class DrainResult(Message):
+    success: bool = True
+    # ranks the master queued urgent checkpoint actions for (phase=
+    # notice): lets the draining agent log the blast radius
+    checkpoint_ranks: List[int] = field(default_factory=list)
 
 
 @dataclass

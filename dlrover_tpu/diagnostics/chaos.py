@@ -23,9 +23,24 @@ Spec grammar (semicolon-separated faults):
                            GlobalStepReports to a master-side injector) —
                            exercises crash-consistent state recovery +
                            agent reconnection (docs/fault_tolerance.md)
+    preempt:worker:1@4:20  rank 1 receives an advance PREEMPTION NOTICE
+                           at step 4 with a 20 s grace window: the fault
+                           atomically writes the notice file the agent's
+                           PreemptionWatcher polls
+                           ($DLROVER_TPU_PREEMPTION_NOTICE), driving the
+                           whole drain chain — notice RPC, urgent
+                           checkpoint fan-out, deadline-bounded
+                           emergency save, clean-drain exit, one-round
+                           world re-formation — deterministically
+                           in-process. Grace defaults to
+                           Context.preempt_default_grace_s.
+    hang:worker:1@3        rank 1 blocks at step 3 (default 60 s) — with
+                           DLROVER_TPU_HANG_WATCHDOG_S under the block
+                           length, the step-hang watchdog fires first:
+                           stack dump + self-abort + agent restart
 
-Each kill/hang fault fires at most once per process; slow applies from
-its step onward. The hook is a no-op (one env read at construction)
+Each kill/hang/preempt fault fires at most once per process; slow
+applies from its step onward. The hook is a no-op (one env read at construction)
 when the variable is unset — zero cost on the training path.
 
 One-shot markers (CHAOS_STATE_ENV) are keyed by the fault's INDEX in
@@ -57,12 +72,14 @@ CHAOS_STATE_ENV = "DLROVER_TPU_CHAOS_STATE"
 
 @dataclasses.dataclass
 class ChaosFault:
-    action: str            # "kill" | "hang" | "slow"
+    action: str            # "kill" | "hang" | "slow" | "preempt"
     role: str              # node type the fault targets ("worker",
     #                        "master", …)
     rank: int              # node rank within the role
     at_step: int           # fire when the target reaches this step
-    duration: float = 60.0  # hang: block seconds; slow: sleep/step
+    # hang: block seconds; slow: sleep/step; preempt: grace window
+    # (<= 0 → Context.preempt_default_grace_s)
+    duration: float = 60.0
     fired: bool = False
     # position in the FULL spec (before role/rank filtering): the
     # one-shot marker key, stable across respawns that re-parse the
@@ -91,8 +108,10 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
             raise ValueError(
                 f"bad chaos fault {part!r} (want "
                 f"'action:role:rank@step[:duration]'): {e}") from e
-        if fault.action not in ("kill", "hang", "slow"):
+        if fault.action not in ("kill", "hang", "slow", "preempt"):
             raise ValueError(f"unknown chaos action {fault.action!r}")
+        if fault.action == "preempt" and len(at_fields) == 1:
+            fault.duration = 0.0   # grace resolves from Context at fire
         if fault.rank < 0:
             raise ValueError(
                 f"chaos fault {part!r} has negative rank {fault.rank} "
@@ -175,6 +194,40 @@ class ChaosInjector:
                 # record AFTER the sleep: a process killed and respawned
                 # mid-hang must replay the hang, not skip it
                 self._record_fired(fault)
+            elif fault.action == "preempt":
+                # record BEFORE writing the notice: the drain respawns
+                # nothing on this node, but a later incarnation (e.g.
+                # the drain was cancelled operator-side) must not
+                # re-preempt itself forever
+                if not self._record_fired(fault):
+                    continue
+                self._write_preemption_notice(fault, step)
             elif fault.action == "slow":
                 # applies every step from at_step on (a real straggler)
                 time.sleep(fault.duration)
+
+    def _write_preemption_notice(self, fault: ChaosFault,
+                                 step: int) -> None:
+        """Simulate the platform's advance notice: atomically write the
+        JSON notice file the agent's PreemptionWatcher polls."""
+        import json
+
+        from dlrover_tpu.common.config import Context
+        from dlrover_tpu.common.constants import NodeEnv
+
+        path = os.environ.get(NodeEnv.PREEMPTION_NOTICE_FILE, "")
+        grace = (fault.duration if fault.duration > 0
+                 else Context.singleton().preempt_default_grace_s)
+        logger.warning(
+            "chaos: preemption notice for %s-%d at step %d "
+            "(grace %.1fs) -> %s", self._role, self._rank, step,
+            grace, path or "<no notice file configured>")
+        if not path:
+            return
+        payload = {"deadline": time.time() + grace,
+                   "grace_s": grace,
+                   "reason": f"chaos preempt@{fault.at_step}"}
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)

@@ -110,6 +110,83 @@ class DiagnosisManager:
         with self._lock:
             self._node_stats[rank] = entry
 
+    def observe_worker_exit(self, rank: int, exit_kind: str,
+                            detail: str = "") -> None:
+        """A worker departed: record HOW (the diagnosis layer must tell
+        hang from crash from drain — they demand different operator
+        responses and different relaunch arithmetic)."""
+        from dlrover_tpu.common.constants import NodeExitReason
+
+        severity = {
+            NodeExitReason.DRAINED: "info",
+            NodeExitReason.SUCCEEDED: "info",
+            NodeExitReason.HANG: "warning",
+        }.get(exit_kind, "warning")
+        report = DiagnosisReport(
+            rule="worker_exit", severity=severity, worker_id=rank,
+            summary=(f"worker {rank} exited: {exit_kind}"
+                     + (f" ({detail})" if detail else "")),
+            details={"exit_kind": exit_kind},
+            ts=time.time(),
+        )
+        with self._diag_lock:
+            self._emit(report, Context.singleton())
+
+    def observe_drain_notice(self, rank: int, deadline: float,
+                             reason: str = "") -> None:
+        """A preemption notice arrived for ``rank``: record the planned
+        departure so postmortems show the drain was ADVANCE-notified."""
+        report = DiagnosisReport(
+            rule="preemption", severity="info", worker_id=rank,
+            summary=(f"worker {rank} draining: departs in "
+                     f"{max(0.0, deadline - time.time()):.0f}s"
+                     + (f" ({reason})" if reason else "")),
+            details={"deadline": deadline, "reason": reason},
+            ts=time.time(),
+        )
+        with self._diag_lock:
+            self._emit(report, Context.singleton())
+
+    def request_checkpoint(self, ranks, deadline: float,
+                           reason: str = "") -> List[int]:
+        """Urgent ``checkpoint`` fan-out (a peer is draining): enqueue a
+        save-now action for every given rank, BYPASSING the per-rank
+        cooldown — preemption does not wait for cooldowns. Returns the
+        ranks actually queued. The ``diagnosis_actions_enabled``
+        kill-switch still applies: diagnose-only means NO agent-side
+        effects, urgent or not."""
+        if not Context.singleton().diagnosis_actions_enabled:
+            logger.warning(
+                "diagnosis actions disabled: urgent checkpoint fan-out "
+                "for draining peer suppressed (ranks %s)", list(ranks))
+            return []
+        queued: List[int] = []
+        now = time.time()
+        with self._lock:
+            for rank in ranks:
+                queue = self._pending.get(rank)
+                if queue is None:
+                    queue = deque(maxlen=_ACTION_QUEUE_CAP)
+                    self._pending[rank] = queue
+                action_id = self._next_action_id
+                self._next_action_id += 1
+                queue.append({
+                    "id": action_id,
+                    "kind": "checkpoint",
+                    "rank": rank,
+                    "rule": "preemption",
+                    "reason": reason,
+                    "deadline": deadline,
+                    "ts": now,
+                })
+                queued.append(rank)
+        for rank in queued:
+            self._actions_total.labels(kind="checkpoint").inc()
+            obs.get_flight_recorder().record_event(
+                "diagnosis_action", kind="checkpoint", rank=rank,
+                rule="preemption")
+        return queued
+
     def evict_workers(self, live) -> None:
         """Membership-change hook: a departed rank's queued actions and
         cached stats must not outlive it (an agent re-joining under the

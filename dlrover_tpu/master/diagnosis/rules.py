@@ -32,6 +32,10 @@ CRITICAL = "critical"
 ACTION_OBSERVE = "observe"
 ACTION_PROFILE = "profile"
 ACTION_RESTART = "restart"
+# urgent save-now-keep-running: fanned out to survivors when a peer
+# announces a preemption drain (the agent writes the worker's drain
+# request file with exit=False)
+ACTION_CHECKPOINT = "checkpoint"
 ACTION_ALERT = "alert"
 
 
@@ -305,7 +309,7 @@ def parse_action(action: str) -> Dict[str, Any]:
     kind, _, rank = action.partition(":")
     kind = kind.strip().lower()
     if kind not in (ACTION_OBSERVE, ACTION_PROFILE, ACTION_RESTART,
-                    ACTION_ALERT):
+                    ACTION_CHECKPOINT, ACTION_ALERT):
         kind = ACTION_OBSERVE
     try:
         target = int(rank) if rank else -1

@@ -207,6 +207,12 @@ class JobManager:
             # OOM recovery plan: same node back with more host memory
             replacement.config_resource.memory_mb = (
                 node.config_resource.memory_mb * _OOM_MEMORY_FACTOR)
+        elif node.exit_reason == NodeExitReason.DRAINED:
+            # a graceful drain is a PLANNED departure, not a failure:
+            # replace the capacity without charging the relaunch budget
+            # (a job surviving N preemptions must still have its full
+            # budget for real crashes)
+            replacement.relaunch_count = node.relaunch_count
         with self._lock:
             by_id[new_id] = replacement
         logger.info("relaunching %s as %s (attempt %d/%d)", node.name,

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -74,6 +75,12 @@ class RendezvousManager:
         # the servicer skip the full state export+hash on the
         # steady-state polls, which mutate nothing almost always
         self._mutations = 0
+        # rank -> departure deadline (unix ts): ranks that announced a
+        # preemption drain. Still alive (training until departure), but
+        # the post-departure world is already planned — on
+        # complete_drain (or a blown deadline) the world re-forms in
+        # ONE round instead of waiting out the liveness timeout.
+        self._draining: Dict[int, float] = {}
 
     # -- membership (driven by the node manager / event callbacks) --------
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
@@ -109,14 +116,85 @@ class RendezvousManager:
         with self._lock:
             self._last_seen[node_rank] = time.time()
 
+    # -- preemption drain --------------------------------------------------
+    def _publish_draining_gauge(self) -> None:
+        """Republished by EVERY path that mutates the draining set
+        (notice, completion, blown-deadline reap, re-join cancel,
+        death, state restore) — updating it only on the drain RPC
+        would leave phantom perpetually-draining ranks on the others.
+        Called OUTSIDE the manager lock (obs takes its own)."""
+        if self.name != RendezvousName.TRAINING:
+            return
+        with self._lock:
+            count = len(self._draining)
+        obs.get_registry().gauge(
+            "dlrover_tpu_draining_nodes",
+            "Ranks currently draining (announced, not yet departed)",
+        ).set(count)
+
+    def mark_draining(self, node_rank: int, deadline: float
+                      ) -> Dict[int, int]:
+        """A preemption notice for ``node_rank``: it keeps training
+        until departure, but the post-departure world is planned NOW.
+        Returns that planned world (latest world minus every draining
+        rank) so the caller can log/verify the one-round target."""
+        with self._lock:
+            if node_rank in self._alive_nodes:
+                self._draining[node_rank] = deadline
+                self._mutations += 1
+            planned = {rank: n for rank, n in self._latest_world.items()
+                       if rank not in self._draining}
+        logger.info(
+            "%s rendezvous: node %d DRAINING (deadline %.0fs away); "
+            "planned post-departure world %s", self.name, node_rank,
+            max(0.0, deadline - time.time()), sorted(planned))
+        self._publish_draining_gauge()
+        return planned
+
+    def complete_drain(self, node_rank: int) -> bool:
+        """The drained worker exited clean: remove the rank immediately
+        (planned departure — no liveness timeout) so survivors re-form
+        in one round. Returns whether the rank was known draining."""
+        with self._lock:
+            was_draining = self._draining.pop(node_rank, None) is not None
+        # NOT graceful: the cut world contained the drained rank, so
+        # survivors must re-join for the planned smaller world — and
+        # with the rank out of the alive set the new round cuts as soon
+        # as the last survivor joins (no wait_new_node_s stall)
+        self.remove_alive_node(node_rank, graceful=False)
+        obs.get_flight_recorder().record_event(
+            "node_drained", rdzv=self.name, rank=node_rank,
+            announced=was_draining)
+        return was_draining
+
+    @property
+    def draining(self) -> Dict[int, float]:
+        with self._lock:
+            return dict(self._draining)
+
     def reap_dead_nodes(self, timeout_s: float) -> None:
         """Declare ranks silent for > timeout_s dead (world invalidation
         via remove_alive_node). 0/negative disables. Runs on live agents'
         polls — no master thread needed, and with no live agents there is
-        nobody left to tell anyway."""
+        nobody left to tell anyway.
+
+        Draining ranks whose departure deadline passed are reaped
+        regardless of the liveness timeout: the platform took the VM at
+        the deadline even if the drain-complete RPC was lost."""
+        now = time.time()
+        with self._lock:
+            overdue = [rank for rank, deadline in self._draining.items()
+                       if now > deadline + 5.0]
+            for rank in overdue:
+                del self._draining[rank]
+        for rank in overdue:
+            logger.warning(
+                "%s rendezvous: draining node %d blew its departure "
+                "deadline without reporting completion; removing it",
+                self.name, rank)
+            self.remove_alive_node(rank, graceful=False)
         if timeout_s <= 0:
             return
-        now = time.time()
         with self._lock:
             dead = [rank for rank in self._alive_nodes
                     if now - self._last_seen.get(rank, now) > timeout_s]
@@ -136,6 +214,7 @@ class RendezvousManager:
             self._alive_nodes.discard(node_rank)
             self._waiting.pop(node_rank, None)
             self._pending_rejoin.discard(node_rank)
+            self._draining.pop(node_rank, None)
             self._mutations += 1
             if not graceful and node_rank in self._latest_world:
                 # A member of the cut round died: any survivor handed this
@@ -154,6 +233,7 @@ class RendezvousManager:
                 self._on_world_invalidated()
                 invalidated_round = self._rdzv_round - 1
         # obs sinks run OUTSIDE the manager lock (they take their own)
+        self._publish_draining_gauge()
         if invalidated_round is not None:
             obs.get_flight_recorder().record_event(
                 "world_invalidated", rdzv=self.name,
@@ -178,6 +258,9 @@ class RendezvousManager:
             self._alive_nodes.add(node_rank)
             self._last_seen[node_rank] = time.time()
             self._pending_rejoin.discard(node_rank)
+            # a re-joining rank is no longer departing (drain cancelled
+            # operator-side, or the platform gave the VM back)
+            self._draining.pop(node_rank, None)
             if node_ip:
                 self._node_ips[node_rank] = node_ip
             if len(self._waiting) == 1:
@@ -188,6 +271,7 @@ class RendezvousManager:
             "dlrover_tpu_rendezvous_joins_total",
             "join_rendezvous RPCs accepted", labelnames=("rdzv",),
         ).labels(rdzv=self.name).inc()
+        self._publish_draining_gauge()
         return joined_round
 
     def leave_waiting(self, node_rank: int) -> None:
@@ -340,6 +424,8 @@ class RendezvousManager:
                 "pending_rejoin": sorted(self._pending_rejoin),
                 "node_ips": {str(r): ip
                              for r, ip in self._node_ips.items()},
+                "draining": {str(r): deadline
+                             for r, deadline in self._draining.items()},
             }
             # subclass fields join the SAME cut: one lock acquisition,
             # never two cuts with a mutation in between
@@ -368,12 +454,19 @@ class RendezvousManager:
             self._node_ips = {int(r): ip
                               for r, ip in state.get("node_ips",
                                                      {}).items()}
+            # absolute deadlines survive the restart as-is: a drain
+            # announced before the master died is still a drain, and a
+            # blown deadline is reaped on the first poll
+            self._draining = {int(r): float(d)
+                              for r, d in state.get("draining",
+                                                    {}).items()}
             # every restored member gets a fresh liveness clock: agents
             # re-register within their poll interval, the genuinely dead
             # age out through the normal reap path
             self._last_seen = {rank: now for rank in self._alive_nodes}
             self._latest_round_start = now
             self._restore_extra(state)
+        self._publish_draining_gauge()
 
     def _restore_extra(self, state: dict) -> None:
         """Subclass hook restoring extra exported fields (lock held)."""

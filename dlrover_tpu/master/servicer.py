@@ -237,6 +237,8 @@ class MasterServicer:
                                             generation=self.generation)
         elif isinstance(request, msg.ReconnectRequest):
             return self._handle_reconnect(request)
+        elif isinstance(request, msg.DrainReport):
+            return self._handle_drain(request)
         elif isinstance(request, msg.LeaveRendezvousRequest):
             mgr = self.rdzv_managers[request.rdzv_name]
             mgr.leave_waiting(request.node_rank)
@@ -282,12 +284,20 @@ class MasterServicer:
                     node_type=request.node_type)
             self._touch_rendezvous(request.node_rank)
         elif isinstance(request, msg.NodeFailureReport):
-            logger.warning("node %d failure (level=%s): %s",
+            logger.warning("node %d failure (level=%s, kind=%s): %s",
                            request.node_id, request.level,
+                           request.exit_kind or "-",
                            request.error_data[:512])
             if self.job_manager is not None:
                 self.job_manager.handle_failure_report(request)
             self.task_manager.recover_tasks(request.node_id)
+            if self.diagnosis_manager is not None and request.exit_kind:
+                # hang vs crash vs drain lands in the report history —
+                # they demand different responses
+                self.diagnosis_manager.observe_worker_exit(
+                    request.node_rank if request.node_rank >= 0
+                    else request.node_id,
+                    request.exit_kind, detail=request.error_data[:128])
         elif isinstance(request, msg.NodeAddressReport):
             self.kv_store.set(f"node-addr/{request.node_rank}",
                               request.addr.encode())
@@ -365,6 +375,57 @@ class MasterServicer:
                                    world_intact=intact,
                                    round=latest_round)
 
+    def _handle_drain(self, request: msg.DrainReport) -> msg.DrainResult:
+        """The advance-notice drain protocol. phase="notice": mark the
+        rank DRAINING in every rendezvous, pre-plan the post-departure
+        world, and fan an urgent ``checkpoint`` action out to the
+        SURVIVORS (the draining agent checkpoints its own worker
+        locally). phase="complete": remove the rank now — survivors
+        re-form in one round instead of waiting out the liveness
+        timeout."""
+        rank = (request.node_rank if request.node_rank >= 0
+                else request.node_id)
+        checkpoint_ranks = []
+        if request.phase == "complete":
+            announced = False
+            for mgr in self.rdzv_managers.values():
+                announced = mgr.complete_drain(rank) or announced
+                self._evict_departed(mgr)
+            logger.info("node %d drain COMPLETE (announced=%s): "
+                        "survivors re-form now", rank, announced)
+        else:
+            planned = {}
+            for name, mgr in self.rdzv_managers.items():
+                world = mgr.mark_draining(rank, request.deadline)
+                if name == RendezvousName.TRAINING:
+                    planned = world
+            survivors = sorted(r for r in planned if r != rank)
+            if self.diagnosis_manager is not None:
+                self.diagnosis_manager.observe_drain_notice(
+                    rank, request.deadline, request.reason)
+                checkpoint_ranks = (
+                    self.diagnosis_manager.request_checkpoint(
+                        survivors, request.deadline,
+                        reason=f"peer rank {rank} draining: "
+                               f"{request.reason}"))
+            obs.get_flight_recorder().record_event(
+                "node_draining", rank=rank, deadline=request.deadline,
+                reason=request.reason[:256],
+                planned_world=sorted(planned),
+                checkpoint_ranks=checkpoint_ranks)
+        obs.get_registry().counter(
+            "dlrover_tpu_drains_total",
+            "Drain protocol messages by phase",
+            labelnames=("phase",)).labels(phase=request.phase).inc()
+        # dlrover_tpu_draining_nodes is published by the rendezvous
+        # manager itself: every mutation path (including blown-deadline
+        # reaps and re-join cancels that never pass through this RPC)
+        # keeps the gauge honest
+        self._sink_state()
+        return msg.DrainResult(success=True,
+                               checkpoint_ranks=checkpoint_ranks)
+
+    # ------------------------------------------------------------------
     def _sink_state(self) -> None:
         """Post-mutation crash-consistency hook; snapshot failures must
         never fail the RPC that triggered them."""

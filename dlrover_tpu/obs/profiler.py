@@ -137,7 +137,7 @@ class ProfilerSession:
         self._static_num = static_num
         self._static_done = False
         self._active: Optional[ProfilerCapture] = None
-        self._last_request_mtime = -1.0
+        self._last_request_stat = None
         self._handled_id = -1
         # a respawned worker must not replay a request its predecessor
         # already served (the agent leaves the request file in place):
@@ -221,12 +221,17 @@ class ProfilerSession:
         if not self._request_path:
             return None
         try:
-            mtime = os.stat(self._request_path).st_mtime
+            st = os.stat(self._request_path)
         except OSError:
             return None
-        if mtime == self._last_request_mtime:
+        # inode in the key (same contract as the drain-request channel):
+        # every write is a tmp+rename, so a rewrite inside one coarse
+        # mtime tick (1 s on some NFS) still changes the key — bare
+        # mtime would skip that request forever
+        stat_key = (st.st_ino, st.st_mtime_ns, st.st_size)
+        if stat_key == self._last_request_stat:
             return None
-        self._last_request_mtime = mtime
+        self._last_request_stat = stat_key
         try:
             with open(self._request_path) as f:
                 request = json.load(f)

@@ -18,7 +18,12 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, Iterator, List, Optional
 
-from dlrover_tpu.common.constants import NodeEnv, NodeStatus
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    NodeExitReason,
+    NodeStatus,
+    WorkerExit,
+)
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.node import NodeResource
 
@@ -222,6 +227,15 @@ def build_pod_manifest(
         {"name": NodeEnv.NODE_NUM, "value": str(node_num)},
         {"name": NodeEnv.JOB_NAME, "value": job_name},
     ]
+    from dlrover_tpu.common.config import Context
+
+    watchdog_s = Context.singleton().hang_watchdog_s
+    if watchdog_s > 0:
+        # ship the watchdog knob into the pod: the worker enables the
+        # watchdog, and pod_to_fields can classify a SIGABRT exit (134)
+        # from the pod spec instead of guessing from master-side config
+        env.append({"name": _HANG_WATCHDOG_ENV,
+                    "value": str(watchdog_s)})
     limits = resource_to_limits(resource)
     node_selector = tpu_node_selector(resource.chip_type, tpu_topology)
     manifest: Dict[str, Any] = {
@@ -259,6 +273,28 @@ def build_pod_manifest(
     return manifest
 
 
+# the Context env-override name for hang_watchdog_s (common/config.py
+# derives DLROVER_TPU_<FIELD_UPPER>): build_pod_manifest ships it into
+# worker pods, pod_to_fields reads it back for exit classification
+_HANG_WATCHDOG_ENV = "DLROVER_TPU_HANG_WATCHDOG_S"
+
+
+def _pod_hang_enabled(pod: Dict[str, Any]) -> bool:
+    """Whether THIS pod ran with the step-hang watchdog on — from the
+    pod's own spec env when present (the worker knob is set per pod,
+    not on the master), falling back to the master's Context."""
+    for container in pod.get("spec", {}).get("containers", []):
+        for entry in container.get("env", []) or []:
+            if entry.get("name") == _HANG_WATCHDOG_ENV:
+                try:
+                    return float(entry.get("value", "0") or "0") > 0
+                except ValueError:
+                    return False
+    from dlrover_tpu.common.config import Context
+
+    return Context.singleton().hang_watchdog_s > 0
+
+
 def pod_to_fields(pod: Dict[str, Any]) -> Dict[str, Any]:
     """Parse a pod object into the watcher's neutral fields (reference:
     PodWatcher._convert_pod_event, master/watcher/k8s_watcher.py:130-193)."""
@@ -272,15 +308,24 @@ def pod_to_fields(pod: Dict[str, Any]) -> Dict[str, Any]:
             reason = term.get("reason", "")
             code = term.get("exitCode")
             # OOM only on the kernel OOM reason or exit 247; SIGKILL/SIGTERM
-            # (137/143 — eviction, preemption) are plain kills and must not
-            # trigger the OOM memory bump on relaunch (reference:
-            # master/watcher/k8s_watcher.py _get_pod_exit_reason).
+            # (137/143 — eviction, platform force-kill) are plain kills and
+            # must not trigger the OOM memory bump on relaunch (reference:
+            # master/watcher/k8s_watcher.py _get_pod_exit_reason). Drain /
+            # hang / kill share WorkerExit.classify with the agent — one
+            # exit-code vocabulary, so the diagnosis rules and the relaunch
+            # budget see the same truth either way a pod dies.
+            if code is not None:
+                kind = WorkerExit.classify(
+                    code, hang_enabled=_pod_hang_enabled(pod))
+            else:
+                kind = ""
             if reason == "OOMKilled" or code == 247:
-                exit_reason = "oom"
-            elif code in (137, 143):
-                exit_reason = "killed"
+                exit_reason = NodeExitReason.OOM
+            elif kind in (NodeExitReason.DRAINED, NodeExitReason.HANG,
+                          NodeExitReason.KILLED):
+                exit_reason = kind
             elif reason == "Error":
-                exit_reason = "unknown_error"
+                exit_reason = NodeExitReason.UNKNOWN_ERROR
     return {
         "name": meta.get("name", ""),
         "node_type": labels.get("dlrover-tpu/type", ""),

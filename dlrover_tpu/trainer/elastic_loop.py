@@ -27,7 +27,9 @@ import jax
 import numpy as np
 
 from dlrover_tpu import obs
+from dlrover_tpu.agent.preemption import DrainRequestSource
 from dlrover_tpu.checkpoint import FlashCheckpointer
+from dlrover_tpu.common.constants import WorkerExit
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh, dp_size
 from dlrover_tpu.trainer.sampler import ElasticDistributedSampler
@@ -35,6 +37,17 @@ from dlrover_tpu.trainer.train_step import (
     build_trainer,
     choose_accumulation,
 )
+from dlrover_tpu.trainer.watchdog import StepHangWatchdog
+
+
+class DrainExit(SystemExit):
+    """Clean graceful drain: the loop consumed a preemption drain
+    request, ran the emergency checkpoint, and the process must exit
+    with the clean-drain code the agent classifies as NON-failure."""
+
+    def __init__(self, reason: str = ""):
+        super().__init__(WorkerExit.DRAIN)
+        self.reason = reason
 
 
 @dataclasses.dataclass
@@ -156,6 +169,16 @@ class ElasticTrainLoop:
             static_start=config.profile_start_step,
             static_num=config.profile_num_steps,
         )
+        # preemption drain / urgent-checkpoint requests from the agent,
+        # consumed at step boundaries (one os.stat per step when armed)
+        self._drain_source = DrainRequestSource()
+        # step-hang backstop: no progress past hang_watchdog_s → stack
+        # dump + self-abort so the agent restarts this worker (0 = off)
+        from dlrover_tpu.common.config import Context
+
+        watchdog_s = Context.singleton().hang_watchdog_s
+        self._watchdog = (StepHangWatchdog(watchdog_s)
+                          if watchdog_s > 0 else None)
         logger.info(
             "elastic loop: dp=%d accum=%d micro(global)=%d mesh=%s",
             self.dp, self.accum, self.micro_global,
@@ -292,10 +315,14 @@ class ElasticTrainLoop:
         """Train over (tokens, targets) global batches. Returns the final
         state and last metrics."""
         raw_metrics: Dict[str, Any] = {}
+        if self._watchdog is not None:
+            self._watchdog.start()
         try:
             return self._run_inner(state, batches, start_step, sampler,
                                    raw_metrics)
         finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
             # a step failure (the expected failure mode here) must still
             # flush an active profiler trace, or the next loop's
             # start_trace raises on the dangling session
@@ -317,6 +344,19 @@ class ElasticTrainLoop:
             "unless a host sync lands in the step)")
         batch_iter = iter(batches)
         while True:
+            # the step BOUNDARY is where a drain request is consumed:
+            # `state` is a complete post-step state here, so the
+            # emergency save never snapshots mid-accumulation
+            drain = self._drain_source.poll()
+            if drain is not None:
+                # the deadline-bounded emergency save can legitimately
+                # block for minutes of Orbax commit: disarm the watchdog
+                # (a save is not a stall), re-arm for save-and-continue
+                if self._watchdog is not None:
+                    self._watchdog.stop()
+                self._consume_drain(drain, step, state, sampler)
+                if self._watchdog is not None:
+                    self._watchdog.start()
             # data-wait measured explicitly: the time this loop starves
             # on the input pipeline is the diagnosis engine's
             # "pipeline-bound, not a hardware straggler" signal
@@ -347,6 +387,8 @@ class ElasticTrainLoop:
                     step, state, self._data_state(sampler), force=forced,
                 )
                 ckpt_s = _time.monotonic() - t_compute_end
+            if self._watchdog is not None:
+                self._watchdog.notify_step(step)
             self.timeline.record(
                 step, _time.monotonic() - t_step,
                 data_wait=t_data - t_step,
@@ -366,6 +408,11 @@ class ElasticTrainLoop:
                 break
             if config.max_steps and step - start_step >= config.max_steps:
                 break
+        # out of the step loop: disarm the watchdog before the final
+        # sync/commit waits (a long but legitimate final checkpoint
+        # commit is not a step hang)
+        if self._watchdog is not None:
+            self._watchdog.stop()
         # the device→host sync point: converting metrics blocks on the
         # last step's results (the only host sync the steady-state loop
         # pays — worth a span so slow syncs are visible in postmortems)
@@ -384,6 +431,57 @@ class ElasticTrainLoop:
             self.timeline.export(self._timeline_path)
         self._flush_telemetry()
         return state, metrics
+
+    # -- preemption drain --------------------------------------------------
+    def _consume_drain(self, drain: Dict[str, Any], step, state,
+                       sampler) -> None:
+        """Act on a drain/checkpoint request from the agent at a step
+        boundary. ``exit=True`` (preemption): deadline-bounded emergency
+        save, flush the postmortem, and leave with the clean-drain exit
+        code (raises :class:`DrainExit`). ``exit=False`` (the master's
+        urgent ``checkpoint`` fan-out): save now, keep training."""
+        import time as _time
+
+        deadline = float(drain.get("deadline", 0.0) or 0.0)
+        reason = str(drain.get("reason", ""))
+        exit_worker = bool(drain.get("exit", True))
+        recorder = obs.get_flight_recorder()
+        recorder.record_event(
+            "train_drain", step=step, deadline=deadline,
+            exit=exit_worker, reason=reason[:256])
+        logger.warning(
+            "drain request at step %d (deadline in %.0fs, exit=%s): %s",
+            step,
+            max(0.0, deadline - _time.time()) if deadline else -1.0,
+            exit_worker, reason or "-")
+        outcome = "no-checkpointer"
+        if self.checkpointer is not None:
+            # the deadline is a hard bound only on the way OUT (this
+            # VM dies then). A survivor's save-and-continue inherits
+            # the draining PEER's deadline — advisory at best: this
+            # worker is not dying, and skipping/aborting its save
+            # because the peer's window is short defeats the fan-out
+            outcome = self.checkpointer.save_emergency(
+                step, state, self._data_state(sampler),
+                deadline=deadline if exit_worker else 0.0)
+        elif exit_worker:
+            logger.error("drain with no checkpointer configured: "
+                         "exiting WITHOUT saving (progress since the "
+                         "last external save is lost)")
+        if not exit_worker:
+            self._drain_source.acknowledge(int(drain.get("seq", 0) or 0))
+            return
+        # the way out: postmortem + telemetry first, then the distinct
+        # clean-drain exit the agent classifies as non-failure
+        if self._timeline_path:
+            self.timeline.export(self._timeline_path)
+        recorder.record_event("train_drained", step=step,
+                              checkpoint=outcome)
+        self._flush_telemetry()
+        recorder.dump(reason="drain")
+        logger.info("drained at step %d (checkpoint: %s); exiting %d",
+                    step, outcome, WorkerExit.DRAIN)
+        raise DrainExit(reason)
 
     # -- progress reporting ------------------------------------------------
     def _report_progress(self, step: int) -> None:

@@ -32,6 +32,16 @@ class TestChaosSpec:
         (fault,) = parse_chaos("kill:master:0@7")
         assert fault.role == "master" and fault.at_step == 7
 
+    def test_preempt_parses_with_and_without_grace(self):
+        preempt, hang = parse_chaos(
+            "preempt:worker:1@4:20;hang:worker:0@3")
+        assert preempt == ChaosFault("preempt", "worker", 1, 4, 20.0)
+        # bare preempt: grace resolves from Context at fire time
+        (bare,) = parse_chaos("preempt:worker:0@2")
+        assert bare.duration == 0.0
+        # bare hang keeps its 60 s default block
+        assert hang == ChaosFault("hang", "worker", 0, 3, 60.0, index=1)
+
     def test_bad_spec_fails_loudly(self):
         with pytest.raises(ValueError, match="bad chaos fault"):
             parse_chaos("kill:worker@5")

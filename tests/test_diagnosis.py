@@ -202,6 +202,26 @@ class TestProfilerSession:
         session.poll(4)
         assert not session.active
 
+    def test_same_mtime_tick_rewrite_still_delivered(self, tmp_path):
+        request = str(tmp_path / "req.json")
+        dump_dir = str(tmp_path / "profiles")
+        session = ProfilerSession(request_path=request)
+        write_profile_request(request, request_id=1, num_steps=1,
+                              dump_dir=dump_dir)
+        st = os.stat(request)
+        session.poll(0)
+        session.poll(1)   # finalize capture 1
+        assert not session.active
+        # a coarse-mtime filesystem (1 s NFS ticks) can stamp the next
+        # request with the SAME mtime: the rename's fresh inode must
+        # still be noticed (same contract as the drain-request channel)
+        write_profile_request(request, request_id=2, num_steps=1,
+                              dump_dir=dump_dir)
+        os.utime(request, ns=(st.st_atime_ns, st.st_mtime_ns))
+        session.poll(2)
+        assert session.active
+        session.stop()
+
     def test_respawn_does_not_replay_completed_request(self, tmp_path):
         request = str(tmp_path / "req.json")
         dump_dir = str(tmp_path / "profiles")
@@ -416,6 +436,21 @@ class TestDiagnosisManager:
             assert reports and manager.poll_actions(1) == []
         finally:
             diag_ctx.update(diagnosis_actions_enabled=True)
+
+    def test_kill_switch_covers_urgent_checkpoint_fanout(self, diag_ctx):
+        # diagnose-only means NO agent-side effects: the drain path's
+        # urgent checkpoint fan-out must honor the switch too (only the
+        # per-rank cooldown bypass is intentional)
+        manager = DiagnosisManager(SpeedMonitor())
+        diag_ctx.update(diagnosis_actions_enabled=False)
+        try:
+            assert manager.request_checkpoint([1, 2], deadline=0.0) == []
+            assert manager.poll_actions(1) == []
+        finally:
+            diag_ctx.update(diagnosis_actions_enabled=True)
+        assert manager.request_checkpoint([1], deadline=0.0) == [1]
+        assert [a["kind"] for a in manager.poll_actions(1)] == [
+            "checkpoint"]
 
     def test_evict_workers_drops_queues_and_stats(self, diag_ctx):
         manager = self._manager_with_straggler(diag_ctx)

@@ -1,0 +1,1050 @@
+"""Preemption-aware graceful drain + step-hang watchdog.
+
+The advance-notice chain end-to-end — notice sources, the drain RPC,
+the master's one-round world pre-planning, the deadline-bounded
+emergency checkpoint, the clean-drain exit classification, relaunch
+backoff/quarantine — plus the worker-side watchdog that backstops it
+all. Heavy pieces run against an in-process master with trivial
+(jax-free) subprocess workers so the whole chain fits tier-1.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu import obs
+from dlrover_tpu.agent.elastic_agent import (
+    ElasticAgent,
+    RelaunchGovernor,
+    WorkerSpec,
+)
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.preemption import (
+    DrainRequestSource,
+    EnvNoticeSource,
+    FileNoticeSource,
+    PreemptionNotice,
+    PreemptionWatcher,
+    SignalNoticeSource,
+    write_drain_request,
+)
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    NodeExitReason,
+    RendezvousName,
+    WorkerExit,
+)
+from dlrover_tpu.master.job_master import JobMaster
+from dlrover_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+    RendezvousParameters,
+)
+from dlrover_tpu.obs.flight_recorder import FlightRecorder
+from dlrover_tpu.trainer.watchdog import StepHangWatchdog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_context():
+    yield
+    Context.reset()
+
+
+# ---------------------------------------------------------------------------
+# Exit-code classification
+# ---------------------------------------------------------------------------
+
+
+class TestExitClassification:
+    def test_classify(self):
+        assert WorkerExit.classify(0) == NodeExitReason.SUCCEEDED
+        assert WorkerExit.classify(76) == NodeExitReason.DRAINED
+        assert WorkerExit.classify(-6) == NodeExitReason.HANG
+        assert WorkerExit.classify(134) == NodeExitReason.HANG
+        assert WorkerExit.classify(137) == NodeExitReason.KILLED
+        assert WorkerExit.classify(143) == NodeExitReason.KILLED
+        assert WorkerExit.classify(-9) == NodeExitReason.KILLED
+        assert WorkerExit.classify(1) == NodeExitReason.UNKNOWN_ERROR
+
+    def test_sigabrt_is_a_crash_when_watchdog_is_off(self):
+        # with hang_watchdog_s == 0 a SIGABRT cannot be the watchdog:
+        # glibc abort()/C++ terminate must charge the relaunch budget
+        assert (WorkerExit.classify(-6, hang_enabled=False)
+                == NodeExitReason.UNKNOWN_ERROR)
+        assert (WorkerExit.classify(134, hang_enabled=False)
+                == NodeExitReason.UNKNOWN_ERROR)
+        # the other buckets are watchdog-independent
+        assert (WorkerExit.classify(76, hang_enabled=False)
+                == NodeExitReason.DRAINED)
+        assert (WorkerExit.classify(137, hang_enabled=False)
+                == NodeExitReason.KILLED)
+
+    def test_pod_exit_reasons_distinct(self):
+        from dlrover_tpu.scheduler.kubernetes import pod_to_fields
+
+        def pod(code, reason=""):
+            return {
+                "metadata": {"labels": {"dlrover-tpu/type": "worker",
+                                        "dlrover-tpu/node-id": "0",
+                                        "dlrover-tpu/rank": "0"}},
+                "status": {"phase": "Failed", "containerStatuses": [
+                    {"state": {"terminated": {"exitCode": code,
+                                              "reason": reason}}}]},
+            }
+
+        Context.singleton().update(hang_watchdog_s=300.0)
+        assert pod_to_fields(pod(76))["exit_reason"] == "drained"
+        assert pod_to_fields(pod(134))["exit_reason"] == "hang"
+        assert pod_to_fields(pod(137))["exit_reason"] == "killed"
+        assert pod_to_fields(pod(143))["exit_reason"] == "killed"
+        assert pod_to_fields(pod(247))["exit_reason"] == "oom"
+        # watchdog off: a pod SIGABRT is a crash, not a hang
+        Context.singleton().update(hang_watchdog_s=0.0)
+        assert pod_to_fields(pod(134))["exit_reason"] != "hang"
+
+    def test_to_exit_status_normalizes_signal_codes(self):
+        # the agent re-exits its worker's code; -6 would truncate to
+        # 250 at the process boundary and become unclassifiable
+        assert WorkerExit.to_exit_status(-6) == 134
+        assert WorkerExit.to_exit_status(-15) == 143
+        assert WorkerExit.to_exit_status(-9) == 137
+        assert WorkerExit.to_exit_status(76) == 76
+        assert WorkerExit.to_exit_status(0) == 0
+        # round-trip: the normalized status classifies identically
+        assert (WorkerExit.classify(WorkerExit.to_exit_status(-6))
+                == NodeExitReason.HANG)
+        assert (WorkerExit.classify(WorkerExit.to_exit_status(-15))
+                == NodeExitReason.KILLED)
+
+    def test_pod_env_classifies_hang_without_master_knob(self):
+        from dlrover_tpu.scheduler.kubernetes import (
+            build_pod_manifest,
+            pod_to_fields,
+        )
+
+        # the watchdog knob lives on WORKER pods; the master's own
+        # Context may never see it — classification must come from the
+        # pod's spec env, not from master-side config
+        Context.singleton().update(hang_watchdog_s=0.0)
+        pod = {
+            "metadata": {"labels": {"dlrover-tpu/type": "worker",
+                                    "dlrover-tpu/node-id": "0",
+                                    "dlrover-tpu/rank": "0"}},
+            "spec": {"containers": [{"env": [
+                {"name": "DLROVER_TPU_HANG_WATCHDOG_S",
+                 "value": "60"}]}]},
+            "status": {"phase": "Failed", "containerStatuses": [
+                {"state": {"terminated": {"exitCode": 134}}}]},
+        }
+        assert pod_to_fields(pod)["exit_reason"] == "hang"
+        # ...and a master that runs with the knob on ships it into the
+        # pods it builds, so the env is there to read back
+        Context.singleton().update(hang_watchdog_s=45.0)
+        manifest = build_pod_manifest(
+            "job", "worker", 0, 0, "img", "python train.py",
+            "10.0.0.1:5000", 1)
+        env = manifest["spec"]["containers"][0]["env"]
+        assert {"name": "DLROVER_TPU_HANG_WATCHDOG_S",
+                "value": "45.0"} in env
+
+
+# ---------------------------------------------------------------------------
+# Notice sources + the drain-request file channel
+# ---------------------------------------------------------------------------
+
+
+class TestNoticeSources:
+    def test_file_source_grace_to_deadline(self, tmp_path):
+        path = str(tmp_path / "notice.json")
+        src = FileNoticeSource(path)
+        assert src.poll() is None                    # absent file
+        with open(path, "w") as f:
+            json.dump({"grace_s": 5.0, "reason": "spot reclaim"}, f)
+        notice = src.poll()
+        assert notice is not None and notice.source == "file"
+        assert 3.0 < notice.deadline - time.time() <= 5.0 + 0.5
+        assert notice.reason == "spot reclaim"
+
+    def test_file_source_absolute_deadline(self, tmp_path):
+        path = str(tmp_path / "notice.json")
+        deadline = time.time() + 42.0
+        with open(path, "w") as f:
+            json.dump({"deadline": deadline}, f)
+        notice = FileNoticeSource(path).poll()
+        assert notice is not None and notice.deadline == deadline
+
+    def test_env_source_horizon(self, monkeypatch):
+        src = EnvNoticeSource()
+        monkeypatch.delenv(NodeEnv.PREEMPTION_AT, raising=False)
+        assert src.poll() is None
+        # far beyond the grace horizon: not yet a drain
+        monkeypatch.setenv(NodeEnv.PREEMPTION_AT,
+                           str(time.time() + 86400))
+        assert src.poll() is None
+        monkeypatch.setenv(NodeEnv.PREEMPTION_AT, str(time.time() + 5))
+        notice = src.poll()
+        assert notice is not None and notice.source == "env"
+        # a job whose full save outlasts the bare-SIGTERM grace widens
+        # the lead time with its own knob: a deadline an hour out fires
+        # NOW under a 2 h horizon instead of 30 s before the VM dies
+        Context.singleton().update(preempt_env_horizon_s=7200.0)
+        monkeypatch.setenv(NodeEnv.PREEMPTION_AT,
+                           str(time.time() + 3600))
+        notice = src.poll()
+        assert notice is not None and notice.source == "env"
+
+    def test_watcher_delivers_once(self, tmp_path):
+        path = str(tmp_path / "notice.json")
+        with open(path, "w") as f:
+            json.dump({"grace_s": 9.0}, f)
+        seen = []
+        watcher = PreemptionWatcher(seen.append,
+                                    sources=[FileNoticeSource(path)],
+                                    poll_s=0.01)
+        assert watcher.poll_once() is not None
+        assert watcher.poll_once() is None           # single delivery
+        assert len(seen) == 1
+        watcher.stop()
+
+
+class TestDrainRequestChannel:
+    def test_roundtrip_and_mtime_dedup(self, tmp_path):
+        path = str(tmp_path / "drain.json")
+        src = DrainRequestSource(path)
+        assert src.poll() is None
+        write_drain_request(path, 1, 123.0, reason="r", exit_worker=True)
+        req = src.poll()
+        assert req == {"seq": 1, "deadline": 123.0, "reason": "r",
+                       "exit": True}
+        assert src.poll() is None                    # unchanged mtime
+        write_drain_request(path, 2, 9.0, exit_worker=False)
+        assert src.poll()["seq"] == 2
+
+    def test_same_mtime_tick_rewrite_still_delivered(self, tmp_path):
+        path = str(tmp_path / "drain.json")
+        src = DrainRequestSource(path)
+        write_drain_request(path, 1, 5.0, exit_worker=False)
+        st = os.stat(path)
+        assert src.poll()["seq"] == 1
+        # a coarse-mtime filesystem (1 s NFS) can stamp the next write
+        # with the SAME mtime: the rename's fresh inode must still be
+        # noticed, or an exit=True drain overwriting a checkpoint
+        # request inside one tick is silently dropped forever
+        write_drain_request(path, 2, 9.0, exit_worker=True)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))
+        req = src.poll()
+        assert req is not None and req["seq"] == 2 and req["exit"]
+
+    def test_ack_survives_respawn(self, tmp_path):
+        path = str(tmp_path / "drain.json")
+        write_drain_request(path, 3, 1.0, exit_worker=False)
+        first = DrainRequestSource(path)
+        req = first.poll()
+        first.acknowledge(req["seq"])
+        # the respawned worker re-reads the same file: the consumed
+        # save-and-continue request must not replay
+        respawn = DrainRequestSource(path)
+        assert respawn.poll() is None
+
+
+def test_sigterm_chains_flight_dump_and_drain_notice(tmp_path):
+    """Regression (satellite): the drain SIGTERM handler and the flight
+    recorder's dump handler must CHAIN — one SIGTERM fires both."""
+    recorder = FlightRecorder(role="chaintest", dump_dir=str(tmp_path))
+    source = SignalNoticeSource()
+    try:
+        # agent install order: drain source first, recorder second —
+        # the recorder's handler chains to its predecessor
+        source.install()
+        recorder.install_signal_handlers()
+        os.kill(os.getpid(), signal.SIGTERM)
+        notice = source.poll()
+        assert notice is not None and notice.source == "sigterm"
+        assert notice.grace_s > 0
+        dump = tmp_path / f"flight-chaintest-{os.getpid()}.json"
+        assert dump.exists(), "flight dump handler did not fire"
+        payload = json.loads(dump.read_text())
+        assert any(e.get("name") == "signal"
+                   for e in payload["events"])
+    finally:
+        recorder.uninstall_signal_handlers()
+        source.close()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog (fake clock)
+# ---------------------------------------------------------------------------
+
+
+class TestStepHangWatchdog:
+    def _watchdog(self, t, aborts, hang_s=10.0, warmup_s=30.0):
+        return StepHangWatchdog(hang_s, poll_s=999.0, warmup_s=warmup_s,
+                                clock=lambda: t[0],
+                                abort_fn=lambda: aborts.append(1))
+
+    def test_progress_keeps_it_quiet(self):
+        t, aborts = [0.0], []
+        wd = self._watchdog(t, aborts)
+        wd.notify_step(1)
+        t[0] = 9.0
+        assert not wd.check_once()
+        wd.notify_step(2)
+        t[0] = 18.0
+        assert not wd.check_once() and aborts == []
+
+    def test_stall_past_budget_aborts_with_stacks(self):
+        t, aborts = [0.0], []
+        wd = self._watchdog(t, aborts)
+        wd.notify_step(5)
+        t[0] = 10.5
+        assert wd.check_once()
+        assert aborts == [1]
+        # a second check must not double-abort
+        assert wd.check_once() and aborts == [1]
+        events = [e for e in obs.get_flight_recorder().snapshot()
+                  if e.get("name") == "step_hang"]
+        assert events, "step_hang event missing from the flight ring"
+        attrs = events[-1]["attrs"]
+        assert attrs["step"] == 5
+        stacks = attrs["stacks"]
+        assert "MainThread" in stacks and stacks["MainThread"]
+
+    def test_warmup_covers_the_first_compile(self):
+        t, aborts = [0.0], []
+        wd = self._watchdog(t, aborts, hang_s=10.0, warmup_s=30.0)
+        t[0] = 20.0                                  # no step yet
+        assert not wd.check_once()
+        t[0] = 31.0
+        assert wd.check_once() and aborts == [1]
+
+    def test_disabled_never_starts(self):
+        wd = StepHangWatchdog(0.0)
+        wd.start()
+        assert wd._thread is None
+
+    def test_rearms_after_stop_for_a_second_run(self):
+        # a driver that calls loop.run() repeatedly on one instance
+        # (bench_restore) must be protected on EVERY run, not just the
+        # first — start() after stop() arms a fresh thread
+        aborts = []
+        wd = StepHangWatchdog(0.2, poll_s=0.02, warmup_s=0.3,
+                              abort_fn=lambda: aborts.append(1))
+        wd.start()
+        wd.notify_step(1)
+        wd.stop()
+        time.sleep(0.4)                  # stall while disarmed: quiet
+        assert aborts == []
+        wd.start()
+        assert wd._thread is not None and wd._thread.is_alive()
+        deadline = time.time() + 5.0
+        while not aborts and time.time() < deadline:
+            time.sleep(0.05)             # warmup 0.3 s, no steps: fires
+        assert aborts == [1]
+        wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# Relaunch backoff + quarantine
+# ---------------------------------------------------------------------------
+
+
+class TestRelaunchGovernor:
+    def test_exponential_backoff_and_quarantine(self):
+        Context.singleton().update(
+            relaunch_backoff_base_s=1.0, relaunch_backoff_max_s=8.0,
+            quarantine_failures=3, quarantine_window_s=100.0)
+        t = [0.0]
+        gov = RelaunchGovernor(clock=lambda: t[0])
+        assert gov.record_failure() == 1.0
+        assert not gov.quarantined
+        t[0] = 1.0
+        assert gov.record_failure() == 2.0
+        t[0] = 2.0
+        assert gov.record_failure() == 4.0
+        assert gov.quarantined                       # 3 in the window
+        t[0] = 3.0
+        assert gov.record_failure() == 8.0           # capped
+
+    def test_window_decay_lifts_backoff(self):
+        Context.singleton().update(
+            relaunch_backoff_base_s=1.0, relaunch_backoff_max_s=60.0,
+            quarantine_failures=3, quarantine_window_s=100.0)
+        t = [0.0]
+        gov = RelaunchGovernor(clock=lambda: t[0])
+        gov.record_failure()
+        gov.record_failure()
+        t[0] = 500.0                                 # both aged out
+        assert not gov.quarantined
+        assert gov.record_failure() == 1.0           # back to base
+
+    def test_zero_quarantine_disables(self):
+        Context.singleton().update(quarantine_failures=0)
+        gov = RelaunchGovernor()
+        for _ in range(10):
+            gov.record_failure()
+        assert not gov.quarantined
+
+    def test_slow_hang_loop_quarantines_despite_the_window(self):
+        # a deterministic hang with a minutes-long watchdog cycle never
+        # lands quarantine_failures inside the time window — the
+        # consecutive no-progress-hang count must catch it anyway
+        Context.singleton().update(
+            quarantine_failures=3, quarantine_window_s=600.0,
+            hang_watchdog_s=300.0)
+        t = [0.0]
+        gov = RelaunchGovernor(clock=lambda: t[0])
+        for i in range(3):
+            t[0] = 650.0 * (i + 1)       # one abort per ~11 min
+            gov.record_failure()
+            gov.record_hang(lifetime_s=650.0)
+            assert gov.recent_failures == 1   # window never accumulates
+        assert gov.quarantined
+
+    def test_long_lived_incarnation_resets_hang_streak(self):
+        # rare hangs separated by hours of real progress are the
+        # watchdog doing its job — they must never quarantine
+        Context.singleton().update(quarantine_failures=3,
+                                   hang_watchdog_s=300.0)
+        gov = RelaunchGovernor()
+        gov.record_hang(650.0)
+        gov.record_hang(650.0)
+        gov.record_hang(7200.0)          # outlived the progress horizon
+        gov.record_hang(650.0)
+        gov.record_hang(650.0)
+        assert not gov.quarantined
+
+    def test_progressing_incarnation_is_not_an_early_hang(self):
+        # a worker that pushed the job's step high-water mark before
+        # wedging is a flaky collective, not a deterministic hang loop
+        # — short lifetime alone must not count it toward quarantine
+        Context.singleton().update(quarantine_failures=3,
+                                   quarantine_window_s=600.0,
+                                   hang_watchdog_s=300.0)
+        t = [0.0]
+        gov = RelaunchGovernor(clock=lambda: t[0])
+        for _ in range(10):
+            t[0] += 1000.0
+            gov.record_hang(650.0, made_progress=True)
+            gov.record_failure(650.0, made_progress=True)
+        assert not gov.quarantined
+
+    def test_productive_crash_breaks_the_hang_streak(self):
+        # hangs separated by incarnations that train for days and then
+        # CRASH are not 'consecutive' — any productive death resets the
+        # streak, not just a productive hang
+        Context.singleton().update(quarantine_failures=3,
+                                   quarantine_window_s=600.0,
+                                   hang_watchdog_s=300.0)
+        t = [0.0]
+        gov = RelaunchGovernor(clock=lambda: t[0])
+        for _ in range(5):
+            t[0] += 1000.0
+            gov.record_hang(650.0)               # early no-progress hang
+            gov.record_failure(650.0)
+            t[0] += 1000.0
+            gov.record_failure(200000.0)         # long run, then SIGSEGV
+        assert not gov.quarantined
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous: draining, one-round re-formation, state roundtrip
+# ---------------------------------------------------------------------------
+
+
+class TestRendezvousDraining:
+    def _cut_world(self, mgr, ranks):
+        for rank in ranks:
+            mgr.join_rendezvous(rank, 1)
+        _, _, world = mgr.get_comm_world(ranks[0])
+        assert sorted(world) == sorted(ranks)
+        return world
+
+    def test_mark_and_complete_drain_reforms_in_one_round(self):
+        # wait_new_node_s deliberately HUGE: if re-formation needed the
+        # grace window, this test would hang past its assertions
+        mgr = ElasticTrainingRendezvousManager(
+            RendezvousParameters(min_nodes=1, max_nodes=2,
+                                 wait_new_node_s=3600.0))
+        self._cut_world(mgr, [0, 1])
+        planned = mgr.mark_draining(1, time.time() + 60.0)
+        assert planned == {0: 1}
+        assert 1 in mgr.draining
+        # survivors keep training until the actual departure
+        assert mgr.num_nodes_waiting() == 0
+        assert mgr.complete_drain(1)
+        assert mgr.alive_nodes == {0}
+        assert mgr.num_nodes_waiting() >= 1          # survivors told now
+        # survivor re-joins → the round cuts IMMEDIATELY (every alive
+        # node joined), no wait_new_node_s stall, no liveness timeout
+        mgr.join_rendezvous(0, 1)
+        rdzv_round, _, world = mgr.get_comm_world(0)
+        assert world == {0: 1}
+
+    def test_blown_deadline_reaped_without_liveness_timeout(self):
+        mgr = ElasticTrainingRendezvousManager(
+            RendezvousParameters(1, 2, wait_new_node_s=3600.0))
+        self._cut_world(mgr, [0, 1])
+        mgr.mark_draining(1, time.time() - 30.0)     # deadline long gone
+        mgr.reap_dead_nodes(timeout_s=0.0)           # liveness DISABLED
+        assert 1 not in mgr.alive_nodes
+        assert mgr.draining == {}
+
+    def test_rejoin_cancels_drain(self):
+        mgr = ElasticTrainingRendezvousManager(RendezvousParameters(1, 2))
+        self._cut_world(mgr, [0, 1])
+        mgr.mark_draining(1, time.time() + 60.0)
+        mgr.join_rendezvous(1, 1)                    # the VM came back
+        assert mgr.draining == {}
+
+    def test_draining_survives_state_roundtrip(self):
+        mgr = ElasticTrainingRendezvousManager(RendezvousParameters(1, 2))
+        self._cut_world(mgr, [0, 1])
+        deadline = time.time() + 60.0
+        mgr.mark_draining(1, deadline)
+        restored = ElasticTrainingRendezvousManager(
+            RendezvousParameters(1, 2))
+        restored.restore_state(mgr.export_state())
+        assert restored.draining == {1: deadline}
+
+
+# ---------------------------------------------------------------------------
+# Emergency checkpoint (deadline-bounded)
+# ---------------------------------------------------------------------------
+
+
+class TestEmergencyCheckpoint:
+    def test_window_too_small_skips(self, tmp_path):
+        from dlrover_tpu.checkpoint import FlashCheckpointer
+
+        ckpt = FlashCheckpointer(str(tmp_path / "ckpt"))
+        try:
+            outcome = ckpt.save_emergency(
+                1, None, deadline=time.time() + 0.01, min_window_s=2.0)
+            assert outcome == "skipped"
+            assert ckpt.latest_step() is None        # nothing dispatched
+        finally:
+            ckpt.close()
+
+    def test_await_in_flight_save_keeps_estimate_honest(self, tmp_path):
+        # a drain landing on an interval-save boundary awaits the save
+        # already in flight; the residual commit tail it measures is NOT
+        # a full-save wall time and must not become the skip floor
+        import numpy as np
+
+        from dlrover_tpu.checkpoint import FlashCheckpointer
+
+        ckpt = FlashCheckpointer(str(tmp_path / "ckpt"))
+        try:
+            state = {"x": np.arange(4, dtype=np.float32)}
+            assert ckpt.maybe_save(5, state, force=True)
+            outcome = ckpt.save_emergency(
+                5, state, deadline=time.time() + 30.0, min_window_s=0.0)
+            assert outcome == "saved"
+            assert ckpt._last_full_save_s == 0.0     # estimate untouched
+        finally:
+            ckpt.close()
+
+    def test_no_deadline_ignores_the_skip_floor(self, tmp_path):
+        # a survivor's save-and-continue inherits the draining PEER's
+        # deadline only as advisory (the loop passes deadline=0): even
+        # with a huge last-full-save estimate the save must run — this
+        # worker is not dying
+        import numpy as np
+
+        from dlrover_tpu.checkpoint import FlashCheckpointer
+
+        ckpt = FlashCheckpointer(str(tmp_path / "ckpt"))
+        try:
+            ckpt._last_full_save_s = 3600.0
+            state = {"x": np.arange(4, dtype=np.float32)}
+            outcome = ckpt.save_emergency(7, state, deadline=0.0,
+                                          min_window_s=2.0)
+            assert outcome == "saved"
+            assert ckpt.latest_step() == 7
+        finally:
+            ckpt.close()
+
+    def test_chaos_grammar_preempt(self):
+        from dlrover_tpu.diagnostics.chaos import parse_chaos
+
+        (fault,) = parse_chaos("preempt:worker:1@4:20")
+        assert (fault.action, fault.rank, fault.at_step,
+                fault.duration) == ("preempt", 1, 4, 20.0)
+        (bare,) = parse_chaos("preempt:worker:0@2")
+        assert bare.duration == 0.0                  # Context default
+
+    def test_chaos_preempt_writes_notice_once(self, tmp_path,
+                                              monkeypatch):
+        from dlrover_tpu.diagnostics.chaos import ChaosInjector
+
+        path = tmp_path / "notice.json"
+        monkeypatch.setenv(NodeEnv.PREEMPTION_NOTICE_FILE, str(path))
+        inj = ChaosInjector(role="worker", rank=1,
+                            spec="preempt:worker:1@4:7")
+        inj.maybe_inject(3)
+        assert not path.exists()
+        inj.maybe_inject(4)
+        payload = json.loads(path.read_text())
+        assert payload["grace_s"] == 7.0
+        assert 0 < payload["deadline"] - time.time() <= 7.5
+        path.unlink()
+        inj.maybe_inject(5)                          # one-shot: no refire
+        assert not path.exists()
+
+
+def test_drain_request_drains_elastic_loop(cpu_devices, tmp_path,
+                                           monkeypatch):
+    """The worker half of the tentpole, in-process with real jax/Orbax:
+    a drain request lands mid-run → the loop consumes it at the next
+    step boundary, the emergency checkpoint COMMITS, and the process
+    leaves via the clean-drain exit code — and a resumed loop restores
+    exactly the drained step."""
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        cross_entropy_loss,
+    )
+    from dlrover_tpu.parallel.mesh import MeshSpec
+    from dlrover_tpu.trainer.elastic_loop import (
+        DrainExit,
+        ElasticTrainLoop,
+        TrainLoopConfig,
+    )
+
+    drain_file = str(tmp_path / "drain.json")
+    monkeypatch.setenv(NodeEnv.DRAIN_REQUEST_FILE, drain_file)
+    cfg = LlamaConfig.tiny(attn_impl="reference")
+    loop = ElasticTrainLoop(
+        Llama(cfg), optax.adamw(1e-3), cross_entropy_loss,
+        TrainLoopConfig(global_batch=8, seq_len=16,
+                        max_micro_per_replica=4, max_steps=100,
+                        checkpoint_dir=str(tmp_path / "ckpt"),
+                        save_interval_steps=1000,  # no interval saves
+                        mesh_spec=MeshSpec()),
+        devices=cpu_devices[:2],
+    )
+    rng = np.random.default_rng(0)
+
+    def batches():
+        for i in range(100):
+            if i == 3:      # request lands while step 4 runs; the
+                # boundary after step 4 consumes it
+                write_drain_request(drain_file, 1, time.time() + 60.0,
+                                    reason="test preemption")
+            tokens = rng.integers(0, cfg.vocab_size, (8, 16),
+                                  dtype=np.int32)
+            yield tokens, tokens
+
+    import jax
+
+    state, start = loop.restore_or_init(jax.random.PRNGKey(0))
+    with pytest.raises(DrainExit) as excinfo:
+        loop.run(state, batches(), start_step=start)
+    assert excinfo.value.code == WorkerExit.DRAIN
+    events = {e.get("name") for e in
+              obs.get_flight_recorder().snapshot()}
+    assert {"train_drain", "emergency_checkpoint",
+            "train_drained"} <= events
+    loop.close()
+    del state
+
+    # the committed emergency checkpoint is restorable at the drained
+    # step — the whole point of the grace window
+    loop2 = ElasticTrainLoop(
+        Llama(cfg), optax.adamw(1e-3), cross_entropy_loss,
+        TrainLoopConfig(global_batch=8, seq_len=16,
+                        max_micro_per_replica=4, max_steps=1,
+                        checkpoint_dir=str(tmp_path / "ckpt"),
+                        save_interval_steps=1000,
+                        mesh_spec=MeshSpec()),
+        devices=cpu_devices[:2],
+    )
+    state2, start2 = loop2.restore_or_init(jax.random.PRNGKey(1))
+    assert start2 == 4
+    loop2.close()
+
+
+# ---------------------------------------------------------------------------
+# Agent-level: clean drain is not a failure; backoff/quarantine live
+# ---------------------------------------------------------------------------
+
+
+def _spec(entry, **kw):
+    kw.setdefault("monitor_interval_s", 0.1)
+    kw.setdefault("rdzv_timeout_s", 30.0)
+    return WorkerSpec(entrypoint=entry, **kw)
+
+
+def test_clean_drain_exit_is_not_a_failure():
+    """A worker leaving with the clean-drain code: no failure report, no
+    relaunch charge, agent exits 0, master removes the rank."""
+    master = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    master.prepare()
+    client = MasterClient(master.addr, node_id=0, node_rank=0)
+    before = len([e for e in obs.get_flight_recorder().snapshot()
+                  if e.get("name") == "worker_failed"])
+    try:
+        agent = ElasticAgent(client, _spec(
+            [sys.executable, "-c", "raise SystemExit(76)"]))
+        assert agent.run() == 0
+        assert agent._restart_count == 0
+        snapshot = obs.get_flight_recorder().snapshot()
+        failed = [e for e in snapshot if e.get("name") == "worker_failed"]
+        assert len(failed) == before, "drain polluted failure evidence"
+        drained = [e for e in snapshot
+                   if e.get("name") == "worker_drained"]
+        assert drained and drained[-1]["attrs"]["exit_code"] == 76
+        assert drained[-1]["attrs"]["clean"] is True
+        # the master processed the drain completion: rank gone
+        mgr = master.rdzv_managers[RendezvousName.TRAINING]
+        assert 0 not in mgr.alive_nodes
+    finally:
+        client.close()
+        master.stop(grace_s=0.1)
+
+
+def test_flapping_worker_backs_off_then_quarantines():
+    """Satellite: a worker that dies instantly every spawn must be paced
+    (exponential backoff) and finally quarantined — never a hot loop."""
+    Context.singleton().update(
+        relaunch_backoff_base_s=0.05, relaunch_backoff_max_s=0.2,
+        quarantine_failures=3, quarantine_window_s=60.0)
+    master = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    master.prepare()
+    client = MasterClient(master.addr, node_id=0, node_rank=0)
+    try:
+        agent = ElasticAgent(client, _spec(
+            [sys.executable, "-c", "raise SystemExit(3)"],
+            max_restarts=99))
+        code = agent.run()
+        assert code == 3
+        # quarantine struck at the 3rd failure, well under max_restarts
+        assert agent._governor.quarantined
+        assert agent._restart_count == 2
+        events = [e.get("name") for e in
+                  obs.get_flight_recorder().snapshot()]
+        assert "relaunch_backoff" in events
+        assert "worker_quarantined" in events
+    finally:
+        client.close()
+        master.stop(grace_s=0.1)
+
+
+def test_preemption_notice_interrupts_relaunch_backoff():
+    """A notice landing during a long relaunch backoff must cut the
+    sleep and drain immediately — sleeping through it would burn the
+    whole grace window and then respawn a worker onto a dying VM."""
+    Context.singleton().update(relaunch_backoff_base_s=30.0,
+                               relaunch_backoff_max_s=30.0)
+    master = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    master.prepare()
+    client = MasterClient(master.addr, node_id=0, node_rank=0)
+    try:
+        agent = ElasticAgent(client, _spec(
+            [sys.executable, "-c", "raise SystemExit(3)"],
+            max_restarts=99))
+
+        def _arm():
+            time.sleep(0.5)              # mid-backoff
+            agent._preempt_notice = PreemptionNotice(
+                deadline=time.time() + 2.0, source="test")
+            agent._preempt_event.set()
+
+        threading.Thread(target=_arm, daemon=True).start()
+        t0 = time.monotonic()
+        code = agent.run()
+        elapsed = time.monotonic() - t0
+        assert code == 3                 # truthful: the worker crashed
+        assert elapsed < 10.0, f"slept through the notice ({elapsed:.1f}s)"
+        assert agent._restart_count == 0  # no respawn onto the dying VM
+        # the drain was announced to the master: rank removed NOW
+        mgr = master.rdzv_managers[RendezvousName.TRAINING]
+        assert 0 not in mgr.alive_nodes
+    finally:
+        client.close()
+        master.stop(grace_s=0.1)
+
+
+def test_preemption_notice_aborts_master_lost_reconnect():
+    """A notice landing while the agent is in master-lost reconnect must
+    abandon the dial loop and return, so the run loop drains locally —
+    burning the grace window against a dead master loses the emergency
+    checkpoint (the drain path already tolerates an unreachable
+    master)."""
+    Context.singleton().update(
+        rpc_timeout_s=0.2, rpc_retries=1, rpc_backoff_s=4.0,
+        rpc_backoff_max_s=4.0, master_reconnect_timeout_s=120.0)
+    client = MasterClient("127.0.0.1:1", node_id=0, node_rank=0)
+    try:
+        agent = ElasticAgent(client, _spec([sys.executable, "-c",
+                                            "pass"]))
+
+        def _arm():
+            time.sleep(0.4)              # mid-dial / mid-backoff
+            agent._preempt_notice = PreemptionNotice(
+                deadline=time.time() + 30.0, source="test")
+            agent._preempt_event.set()
+
+        threading.Thread(target=_arm, daemon=True).start()
+        t0 = time.monotonic()
+        agent._handle_master_loss()      # returns — no MasterLostError
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10.0, (
+            f"reconnect loop ignored the notice ({elapsed:.1f}s)")
+    finally:
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# In-process integration: the full chain (acceptance)
+# ---------------------------------------------------------------------------
+
+_DRAIN_WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from dlrover_tpu.agent.preemption import DrainRequestSource
+from dlrover_tpu.diagnostics.chaos import ChaosInjector
+
+out_path = {out!r}
+def log(line):
+    with open(out_path, "a") as f:
+        f.write(line + "\\n")
+
+log("spawn rank=%s world=%s" % (
+    os.environ["DLROVER_TPU_NODE_RANK"],
+    os.environ["DLROVER_TPU_WORLD_SIZE"]))
+chaos = ChaosInjector()
+drain = DrainRequestSource()
+for step in range(1, 100000):
+    chaos.maybe_inject(step)
+    req = drain.poll()
+    if req is not None and req.get("exit", True):
+        log("drain step=%d" % step)
+        sys.exit(76)
+    elif req is not None:
+        log("checkpoint seq=%d" % req["seq"])
+        drain.acknowledge(req["seq"])
+    time.sleep(0.05)
+"""
+
+
+def _wait_until(predicate, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_preemption_notice_drains_and_reforms_in_one_round(tmp_path):
+    """Acceptance: a chaos-injected preemption notice with a grace
+    window → drain announced, urgent checkpoint fanned to the survivor,
+    worker exits clean-drain, and the re-formed world excludes the
+    drained rank in FAR less wall time than the liveness timeout —
+    asserted from the flight event sequence."""
+    Context.singleton().update(preempt_notice_poll_s=0.05,
+                               diagnosis_action_cooldown_s=0.0)
+    master = JobMaster(min_nodes=1, max_nodes=2, host="127.0.0.1")
+    master.prepare()
+    outs = {r: str(tmp_path / f"worker{r}.log") for r in (0, 1)}
+    clients, agents, threads, results = {}, {}, {}, {}
+    # the chaos fault targets rank 1 only; grace covers the whole drain
+    chaos_env = {"DLROVER_TPU_CHAOS": "preempt:worker:1@5:20",
+                 "DLROVER_TPU_CHAOS_STATE": str(tmp_path / "chaos")}
+    try:
+        for rank in (0, 1):
+            clients[rank] = MasterClient(master.addr, node_id=rank,
+                                         node_rank=rank)
+            script = _DRAIN_WORKER.format(repo=REPO, out=outs[rank])
+            agents[rank] = ElasticAgent(clients[rank], _spec(
+                [sys.executable, "-c", script], env=dict(chaos_env)))
+
+        def _run(rank):
+            results[rank] = agents[rank].run()
+
+        for rank in (0, 1):
+            threads[rank] = threading.Thread(target=_run, args=(rank,),
+                                             daemon=True)
+            threads[rank].start()
+            # stagger so both land in one round
+            time.sleep(0.2)
+        # agent 1's world is the formation witness (agent 0's may have
+        # already moved on to the re-formed world by the time we look)
+        _wait_until(lambda: sorted(agents[1].last_world) == [0, 1],
+                    30.0, "the 2-node world to form")
+        # worker 1 reaches step 5 → chaos writes the notice → the chain
+        # runs; the drained agent exits 0 with NO restart charge
+        threads[1].join(timeout=40.0)
+        assert not threads[1].is_alive(), "drained agent never exited"
+        assert results[1] == 0
+        assert agents[1]._restart_count == 0
+        # survivor re-forms to the planned 1-node world
+        _wait_until(lambda: agents[0].last_world == {0: 1},
+                    30.0, "the survivor world to re-form")
+        # the survivor's worker got the urgent checkpoint fan-out
+        _wait_until(lambda: "checkpoint seq="
+                    in open(outs[0]).read(),
+                    15.0, "the survivor's urgent checkpoint request")
+        # the drained worker exited via the drain path, once
+        drained_log = open(outs[1]).read()
+        assert "drain step=" in drained_log
+        assert drained_log.count("spawn") == 1, (
+            "the drained rank must NOT be respawned")
+
+        # --- flight-dump assertions (all processes share this ring) ---
+        snapshot = obs.get_flight_recorder().snapshot()
+
+        def last_ts(name):
+            matching = [e for e in snapshot if e.get("name") == name]
+            assert matching, f"missing flight event {name!r}"
+            return matching[-1]["ts"]
+
+        notice_ts = last_ts("preempt_notice")
+        assert last_ts("node_draining") >= notice_ts
+        assert last_ts("worker_drained") >= notice_ts
+        assert last_ts("node_drained") >= notice_ts
+        # the re-formed world's spawn on the survivor, world == [0]
+        respawns = [e for e in snapshot
+                    if e.get("name") == "worker_spawn"
+                    and e["attrs"].get("world") == [0]
+                    and e["ts"] >= notice_ts]
+        assert respawns, "no re-formed single-node world spawn"
+        reform_s = respawns[-1]["ts"] - notice_ts
+        timeout_s = Context.singleton().dead_node_timeout_s
+        assert reform_s < timeout_s, (
+            f"re-formation took {reform_s:.1f}s — not faster than the "
+            f"{timeout_s:.0f}s liveness timeout")
+        # and it beat even the grace window: one round, not a reap
+        assert reform_s < 20.0
+    finally:
+        for rank in (0, 1):
+            if rank in agents:
+                agents[rank].shutdown()
+        for thread in threads.values():
+            thread.join(timeout=10.0)
+        for c in clients.values():
+            c.close()
+        master.stop(grace_s=0.1)
+
+
+_HANG_WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from dlrover_tpu.diagnostics.chaos import ChaosInjector
+from dlrover_tpu.trainer.watchdog import StepHangWatchdog
+
+out_path = {out!r}
+with open(out_path, "a") as f:
+    f.write("spawn\\n")
+incarnation = sum(1 for line in open(out_path) if line.strip() == "spawn")
+if incarnation >= 2:
+    sys.exit(0)          # the restarted worker finishes clean
+wd = StepHangWatchdog(1.0, poll_s=0.1, warmup_s=10.0)
+wd.start()
+chaos = ChaosInjector()
+for step in range(1, 100000):
+    wd.notify_step(step)
+    chaos.maybe_inject(step)
+    time.sleep(0.02)
+"""
+
+
+def test_chaos_hang_caught_by_watchdog_and_restarted(tmp_path,
+                                                     monkeypatch):
+    """Acceptance: a chaos-injected hang is detected by the WORKER-side
+    watchdog (not the 30-min master timeout): all-thread stacks land in
+    the worker's flight dump, the agent classifies the SIGABRT as a
+    hang (no relaunch-budget charge) and restarts the worker."""
+    flight_dir = tmp_path / "flight"
+    monkeypatch.setenv(obs.FLIGHT_DIR_ENV, str(flight_dir))
+    # the agent only classifies SIGABRT as a hang when the watchdog is
+    # actually on (in production agent + worker share the env knob)
+    Context.singleton().update(relaunch_backoff_base_s=0.05,
+                               relaunch_backoff_max_s=0.1,
+                               hang_watchdog_s=0.5)
+    master = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    master.prepare()
+    client = MasterClient(master.addr, node_id=0, node_rank=0)
+    out = str(tmp_path / "worker.log")
+    try:
+        script = _HANG_WORKER.format(repo=REPO, out=out)
+        agent = ElasticAgent(client, _spec(
+            [sys.executable, "-c", script],
+            env={"DLROVER_TPU_CHAOS": "hang:worker:0@3:600",
+                 "DLROVER_TPU_CHAOS_STATE": str(tmp_path / "chaos")}))
+        assert agent.run() == 0
+        # hang restarts ride the quarantine window, not max_restarts
+        assert agent._restart_count == 0
+        assert open(out).read().count("spawn") == 2
+        events = [e for e in obs.get_flight_recorder().snapshot()]
+        kinds = [e["attrs"].get("kind") for e in events
+                 if e.get("name") == "worker_failed"]
+        assert NodeExitReason.HANG in kinds
+        assert any(e.get("name") == "worker_hang_abort" for e in events)
+        # the worker's own flight dump carries the stacks
+        dumps = list(flight_dir.glob("flight-*.json"))
+        hang_events = []
+        for dump in dumps:
+            payload = json.loads(dump.read_text())
+            hang_events += [e for e in payload["events"]
+                            if e.get("name") == "step_hang"]
+        assert hang_events, "no step_hang event in any flight dump"
+        stacks = hang_events[-1]["attrs"]["stacks"]
+        assert stacks and any(frames for frames in stacks.values())
+        # the master's diagnosis history tells hang from crash
+        reports = master.diagnosis_manager.reports()
+        exit_reports = [r for r in reports if r["rule"] == "worker_exit"]
+        assert exit_reports
+        assert exit_reports[-1]["details"]["exit_kind"] == (
+            NodeExitReason.HANG)
+    finally:
+        client.close()
+        master.stop(grace_s=0.1)
+
+
+def test_diagnose_tool_renders_lifecycle(tmp_path, capsys):
+    """Satellite: tools/diagnose.py renders drain/hang/quarantine
+    events from a flight dump."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "diagnose_tool", os.path.join(REPO, "tools", "diagnose.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    payload = {
+        "events": [
+            {"kind": "event", "name": "preempt_notice", "ts": 10.0,
+             "attrs": {"rank": 1, "grace_s": 20.0, "source": "file"}},
+            {"kind": "event", "name": "emergency_checkpoint",
+             "ts": 11.0, "attrs": {"step": 5, "outcome": "saved"}},
+            {"kind": "event", "name": "step_hang", "ts": 12.0,
+             "attrs": {"step": 7, "stacks": {"MainThread": ["frame"]}}},
+            {"kind": "event", "name": "worker_quarantined", "ts": 13.0,
+             "attrs": {"exit_code": 3}},
+            {"kind": "event", "name": "worker_spawn", "ts": 14.0,
+             "attrs": {}},                       # not lifecycle: hidden
+        ],
+    }
+    rendered = tool.render_lifecycle(payload)
+    assert "drain/hang lifecycle events: 4" in rendered
+    assert "preempt_notice" in rendered and "source=file" in rendered
+    assert "outcome=saved" in rendered
+    assert "[1 thread stacks dumped]" in rendered
+    assert "worker_quarantined" in rendered
+    assert "worker_spawn" not in rendered
+    # end-to-end through main()
+    dump = tmp_path / "flight.json"
+    dump.write_text(json.dumps(payload))
+    assert tool.main(["--flight", str(dump)]) == 0
+    assert "step_hang" in capsys.readouterr().out

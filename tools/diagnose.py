@@ -69,6 +69,41 @@ def reports_from_flight(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
     return reports
 
 
+# flight events describing the drain / hang / quarantine lifecycle
+# (agent + master + worker sides of the preemption and watchdog paths)
+_LIFECYCLE_EVENTS = (
+    "preempt_notice", "node_draining", "train_drain",
+    "emergency_checkpoint", "train_drained", "worker_drained",
+    "node_drained", "step_hang", "worker_hang_abort",
+    "relaunch_backoff", "worker_quarantined",
+)
+
+
+def render_lifecycle(payload: Dict[str, Any]) -> str:
+    """Drain/hang/quarantine events of a flight dump, time-ordered —
+    the one-glance answer to "was that departure planned, a hang, or a
+    crash, and did the emergency checkpoint land?"."""
+    events = [record for record in payload.get("events", [])
+              if record.get("kind") == "event"
+              and record.get("name") in _LIFECYCLE_EVENTS]
+    lines = [f"drain/hang lifecycle events: {len(events)}"]
+    if not events:
+        return "\n".join(lines)
+    ordered = sorted(events, key=lambda e: e.get("ts", 0.0))
+    t0 = ordered[0].get("ts", 0.0)
+    for record in ordered:
+        attrs = dict(record.get("attrs", {}))
+        stacks = attrs.pop("stacks", None)
+        detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        if stacks:
+            detail += f" [{len(stacks)} thread stacks dumped]"
+        lines.append("+{offset:8.1f}s  {name:<22} {detail}".format(
+            offset=record.get("ts", 0.0) - t0,
+            name=str(record.get("name", "?")),
+            detail=detail).rstrip())
+    return "\n".join(lines)
+
+
 def render_timeline(payload: Dict[str, Any], last: int = 0) -> str:
     """Per-step phase breakdown + windowed summary of an exported ring."""
     steps = payload.get("steps", [])
@@ -152,6 +187,7 @@ def main(argv=None) -> int:
             continue
         print(f"== {path}")
         print(render_reports(reports_from_flight(payload)))
+        print(render_lifecycle(payload))
     for path in ns.timeline:
         payload = _load_json(path)
         if payload is None:
