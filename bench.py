@@ -37,28 +37,15 @@ from dlrover_tpu.models.llama import Llama, LlamaConfig, cross_entropy_loss
 from dlrover_tpu.parallel.mesh import MeshSpec, create_mesh
 from dlrover_tpu.trainer.train_step import build_trainer
 
-# bf16 peak FLOP/s per chip by device kind (public specs).
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5": 459e12,          # v5p
-    "TPU v5e": 197e12,
-    "TPU v5 lite": 197e12,
-    "TPU v6e": 918e12,
-    "TPU v6 lite": 918e12,
-}
+# bf16 peak FLOP/s per chip by device kind: single-sourced in
+# obs/mfu.py (the framework's MFU gauges and this bench must agree)
+from dlrover_tpu.obs import mfu as mfu_math  # noqa: E402
 
 
 def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    # Longest prefix wins ("TPU v5 lite" must not match "TPU v5").
-    best = 0.0
-    best_len = -1
-    for name, flops in PEAK_FLOPS.items():
-        if kind.startswith(name) and len(name) > best_len:
-            best, best_len = flops, len(name)
-    if best:
-        return best
-    return 459e12 if jax.default_backend() == "tpu" else 1e12
+    return mfu_math.peak_flops_per_chip(
+        getattr(device, "device_kind", ""),
+        backend=jax.default_backend())
 
 
 def probe_tpu(timeout_s: float = 120.0) -> bool:
@@ -109,14 +96,16 @@ def _run_json_subprocess(cmd, timeout_s: float, env=None) -> dict:
 
 
 def run_restore_bench(timeout_s: float = 480.0,
-                      at_scale: bool = False) -> float:
+                      at_scale: bool = False) -> dict:
     """Run bench_restore.py in a subprocess tree. The toy mode is
     CPU-staged (JAX_PLATFORMS=cpu for the whole tree): it measures the
     REAL elastic stack — kill detection, re-rendezvous, respawn, orbax
     restore — without competing for the single-client TPU tunnel. The
     --at-scale mode runs the 1.47B bench model ON the chip (multi-GB
     restore + re-jit, VERDICT r3 item 1); it must run while no other
-    process holds the TPU. Returns seconds, or -1.0 on failure."""
+    process holds the TPU. Returns the bench's JSON record ("value" =
+    seconds, plus the per-phase breakdown and goodput summary); an
+    {"error": ...}-shaped dict on failure."""
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_restore.py")
     env = dict(os.environ)
@@ -125,11 +114,42 @@ def run_restore_bench(timeout_s: float = 480.0,
         cmd.append("--at-scale")
     else:
         env["JAX_PLATFORMS"] = "cpu"
-    result = _run_json_subprocess(cmd, timeout_s + 60, env=env)
+    return _run_json_subprocess(cmd, timeout_s + 60, env=env)
+
+
+def _restore_seconds(restore_result: dict) -> float:
     try:
-        return float(result["value"])
+        return float(restore_result["value"])
     except (KeyError, TypeError, ValueError):
         return -1.0
+
+
+def _fold_restore_fields(result: dict, restore_result: dict) -> None:
+    """Fold the restore bench's per-phase breakdown + goodput summary
+    into the scoreboard record (BENCH_r06+ tracks these beside the
+    headline seconds): where each restore second went, and how much of
+    the episode's rank-time was productive."""
+    breakdown = restore_result.get("breakdown") or {}
+    for source, target in (
+            ("orbax_read_s", "restore_orbax_read_s"),
+            ("restore_metadata_read_s", "restore_metadata_read_s"),
+            ("restore_tensor_read_s", "restore_tensor_read_s"),
+            ("restore_decode_s", "restore_decode_s"),
+            ("device_ready_s", "restore_device_put_s"),
+            ("post_sync_s", "restore_post_sync_s"),
+            ("detect_respawn_s", "restore_detect_respawn_s"),
+            ("compile_wait_after_read_s",
+             "restore_compile_wait_s"),
+            ("first_step_s", "restore_first_step_s"),
+            ("restore_read_bandwidth_mbps",
+             "restore_read_bandwidth_mbps"),
+    ):
+        if source in breakdown:
+            result[target] = breakdown[source]
+    for key in ("phase_sum_s", "phase_coverage", "goodput_fraction",
+                "goodput_buckets"):
+        if key in restore_result:
+            result[key] = restore_result[key]
 
 
 def _timed_loop(step_fn, state, tok, tgt, warmup=2, steps=5):
@@ -149,15 +169,18 @@ def _timed_loop(step_fn, state, tok, tgt, warmup=2, steps=5):
 
 
 def _model_flops_per_token(cfg, seq: int) -> float:
-    """6·params credits fwd+bwd matmul FLOPs. With the gather lookup the
-    input embedding table does no matmul, so untied embed params are not
-    credited (tied ones are: the same matrix IS the head matmul). The
-    attention term is QK^T + PV = 4·h·s FLOPs/token fwd, ×3 for
-    fwd+bwd, ÷2 causal (the kernel skips above-diagonal blocks)."""
-    counted = cfg.param_count()
+    """obs/mfu.py's conservative accounting: 6·params fwd+bwd matmul
+    credit (a gather-lookup embedding table with untied output head
+    does no matmul, so those params are not credited) plus the
+    causal-discounted attention term — matching what the kernel
+    actually computes."""
+    uncounted = 0.0
     if cfg.embed_impl == "gather" and not cfg.tie_embeddings:
-        counted -= cfg.vocab_size * cfg.hidden_size
-    return 6.0 * counted + 6.0 * cfg.num_layers * cfg.hidden_size * seq
+        uncounted = cfg.vocab_size * cfg.hidden_size
+    return mfu_math.flops_per_token(
+        cfg.param_count(), num_layers=cfg.num_layers,
+        hidden_size=cfg.hidden_size, seq_len=seq,
+        uncounted_embed_params=uncounted)
 
 
 def _oom_report(e: Exception, **extra) -> int:
@@ -421,7 +444,8 @@ def main() -> None:
 
     apply_jax_platform_env()   # JAX_PLATFORMS=cpu must win on dev machines
     skip_restore = os.environ.get("BENCH_SKIP_RESTORE") == "1"
-    restore_s = -1.0 if skip_restore else run_restore_bench()
+    restore_result = {} if skip_restore else run_restore_bench()
+    restore_s = -1.0 if skip_restore else _restore_seconds(restore_result)
     tpu_unreachable = False
     if os.environ.get("JAX_PLATFORMS", "") != "cpu" and not probe_tpu():
         # wedged tunnel: degrade to CPU so the bench reports instead of
@@ -431,6 +455,7 @@ def main() -> None:
     want_tpu = (os.environ.get("JAX_PLATFORMS", "") != "cpu"
                 and not tpu_unreachable)
     restore_scale_s = -1.0
+    restore_scale_result: dict = {}
     llama7b: dict = {}
     if want_tpu:
         # every TPU phase runs in its OWN subprocess (the tunnel serves
@@ -446,8 +471,9 @@ def main() -> None:
         # devices alone can't tell — CPU devices probe fine)
         if headline.get("on_tpu"):
             if not skip_restore and probe_tpu():
-                restore_scale_s = run_restore_bench(timeout_s=900.0,
-                                                    at_scale=True)
+                restore_scale_result = run_restore_bench(
+                    timeout_s=900.0, at_scale=True)
+                restore_scale_s = _restore_seconds(restore_scale_result)
             if os.environ.get("BENCH_SKIP_7B") != "1":
                 if probe_tpu():
                     llama7b = run_7b_bench()
@@ -471,6 +497,11 @@ def main() -> None:
         "elastic_restore_seconds": restore_s,
         "elastic_restore_seconds_at_scale": restore_scale_s,
     }
+    # the at-scale restore is the number the <30 s target is about:
+    # its breakdown wins when both ran
+    _fold_restore_fields(result, restore_result)
+    if restore_scale_result.get("breakdown"):
+        _fold_restore_fields(result, restore_scale_result)
     if llama7b:
         result["llama7b_tokens_per_sec_per_chip"] = llama7b.get(
             "tokens_per_sec", -1.0)

@@ -90,6 +90,16 @@ def worker_main(ckpt_dir: str, events_file: str, total_steps: int,
         TrainLoopConfig,
     )
 
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    try:
+        # step reports feed the master's goodput ledger (the bench's
+        # goodput_fraction comes from the same accounting production
+        # uses); report every step — this is a bench, not a hot loop
+        client = MasterClient.singleton()
+    except Exception:   # noqa: BLE001 — reports are optional evidence
+        client = None
+
     if at_scale:
         on_tpu = jax.default_backend() == "tpu"
         cfg = LlamaConfig.llama_wide_1b(
@@ -116,8 +126,9 @@ def worker_main(ckpt_dir: str, events_file: str, total_steps: int,
             seq_len=seq_len,
             checkpoint_dir=ckpt_dir,
             save_interval_steps=SAVE_INTERVAL,
-            report_interval_steps=10**9,
+            report_interval_steps=1,
         ),
+        master_client=client,
     )
     loop.install_signal_handler()
     state, start = loop.restore_or_init(jax.random.PRNGKey(0))
@@ -290,24 +301,47 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
         respawn = next(
             (e for e in events
              if e["event"] == "worker_start" and e["t"] > t_kill), None)
+        # the top-level phases that partition kill -> first step
+        # exclusively (the restore_* sub-phases nest inside
+        # orbax_read_s and must NOT be double-summed)
+        exclusive = ("detect_respawn_s", "loop_build_s",
+                     "abstract_state_s", "orbax_read_s",
+                     "device_ready_s", "post_sync_s",
+                     "compile_wait_after_read_s", "first_step_s")
         if respawn is not None:
             breakdown["detect_respawn_s"] = round(
                 respawn["t"] - t_kill, 2)
             measured = sum(
                 v for k, v in breakdown.items()
                 if k in ("abstract_state_s", "orbax_read_s",
-                         "device_ready_s", "compile_wait_after_read_s"))
+                         "device_ready_s", "post_sync_s",
+                         "compile_wait_after_read_s"))
             breakdown["loop_build_s"] = round(
                 restored["t"] - respawn["t"] - measured, 2)
         breakdown["first_step_s"] = round(first["t"] - restored["t"], 2)
         breakdown.update(first.get("first_step_detail") or {})
-        return {
+        phase_sum = sum(breakdown.get(k, 0.0) for k in exclusive)
+        # the accounting's own acceptance: exclusive phases must explain
+        # the headline number (within rounding + event-write jitter)
+        result = {
             "elastic_restore_seconds": round(elapsed, 2),
             "restored_step": restored["step"],
             "first_step_after_restore": first["step"],
             "checkpoint_gb": round(ckpt_bytes / (1 << 30), 2),
             "breakdown": breakdown,
+            "phase_sum_s": round(phase_sum, 2),
+            "phase_coverage": round(phase_sum / elapsed, 3)
+            if elapsed > 0 else 0.0,
         }
+        # the master's goodput ledger saw the whole episode through the
+        # worker's step reports + telemetry spans: its productive
+        # fraction + bucket split ride into the bench JSON so BENCH_r06+
+        # tracks them beside the headline seconds
+        snap = master.goodput_ledger.snapshot()
+        result["goodput_fraction"] = snap.get("goodput_fraction", 0.0)
+        result["goodput_buckets"] = {
+            k: v for k, v in snap.get("buckets", {}).items() if v > 0.0}
+        return result
     finally:
         agent.shutdown()
         client.close()
@@ -341,6 +375,10 @@ def main() -> int:
         "vs_baseline": round(30.0 / max(seconds, 1e-9), 2),
         "breakdown": result.get("breakdown", {}),
         "checkpoint_gb": result["checkpoint_gb"],
+        "phase_sum_s": result.get("phase_sum_s", 0.0),
+        "phase_coverage": result.get("phase_coverage", 0.0),
+        "goodput_fraction": result.get("goodput_fraction", 0.0),
+        "goodput_buckets": result.get("goodput_buckets", {}),
     }))
     return 0
 

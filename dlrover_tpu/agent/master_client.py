@@ -304,15 +304,17 @@ class MasterClient:
 
     # -- health / status --------------------------------------------------
     def report_global_step(self, step: int, step_time_s: float = 0.0,
-                           data_wait_fraction: float = -1.0) -> bool:
+                           data_wait_fraction: float = -1.0,
+                           mfu: float = -1.0) -> bool:
         """Step progress, optionally with the sender's windowed speed
         evidence (mean step wall time + data-wait fraction from the
-        worker's phase timeline) — the diagnosis engine's straggler /
-        data-bound input."""
+        worker's phase timeline, achieved MFU from its FLOPs model) —
+        the diagnosis engine's straggler / data-bound / collapse
+        input and the goodput ledger's productive-time accrual."""
         return self._report(msg.GlobalStepReport(
             node_id=self.node_id, step=step, timestamp=time.time(),
             node_rank=self.node_rank, step_time_s=step_time_s,
-            data_wait_fraction=data_wait_fraction,
+            data_wait_fraction=data_wait_fraction, mfu=mfu,
         )).success
 
     # -- diagnosis --------------------------------------------------------
@@ -386,14 +388,35 @@ class MasterClient:
 
     def report_model_info(self, param_count: int, param_bytes: int,
                           flops_per_step: float = 0.0,
-                          batch_size: int = 0, seq_len: int = 0) -> bool:
+                          batch_size: int = 0, seq_len: int = 0,
+                          flops_per_token: float = 0.0,
+                          peak_flops_per_chip: float = 0.0,
+                          chips: int = 0,
+                          flops_source: str = "") -> bool:
         """Static model stats for the resource optimizer (reference:
-        profile_extractor reporting ModelInfo)."""
+        profile_extractor reporting ModelInfo) plus the FLOPs model
+        that turns the master's tokens/s series into MFU gauges."""
         return self._report(msg.ModelInfo(
             param_count=param_count, param_bytes=param_bytes,
             flops_per_step=flops_per_step, batch_size=batch_size,
-            seq_len=seq_len,
+            seq_len=seq_len, flops_per_token=flops_per_token,
+            peak_flops_per_chip=peak_flops_per_chip, chips=chips,
+            flops_source=flops_source,
         )).success
+
+    def get_goodput(self, window_s: float = 0.0) -> dict:
+        """The master's goodput-ledger snapshot (tools/goodput.py)."""
+        import json
+
+        result = self._get_typed(msg.GoodputRequest(window_s=window_s),
+                                 msg.GoodputReport)
+        if not result.report_json:
+            return {}
+        try:
+            snap = json.loads(result.report_json)
+        except json.JSONDecodeError:
+            return {}
+        return snap if isinstance(snap, dict) else {}
 
     def report_telemetry(self, samples=None, spans=None) -> bool:
         """Push metric samples + finished span dicts to the master's

@@ -96,6 +96,12 @@ class FlashCheckpointer:
         # emergency path's estimate of whether a deadline is winnable;
         # 0 = no evidence yet (guarded by _lock)
         self._last_full_save_s = 0.0
+        # per-phase breakdown of the last successful restore (step
+        # discovery / metadata read / tensor read / decode, plus bytes
+        # and effective bandwidth) — merged into the elastic loop's
+        # restore timings and the restore bench's JSON. Written only by
+        # the restoring thread; read after restore() returns.
+        self.last_restore_phases: Dict[str, float] = {}
 
     # ------------------------------------------------------------------
     def maybe_save(self, step: int, state: Any,
@@ -268,8 +274,18 @@ class FlashCheckpointer:
 
         Quantized checkpoints are detected from the data item's marker
         (written by maybe_save), decoded on device into the abstract
-        state's dtypes + shardings."""
-        steps = sorted(self._manager.all_steps() or (), reverse=True)
+        state's dtypes + shardings. The per-phase wall-clock (step
+        discovery, metadata read, tensor read, decode) lands in
+        ``last_restore_phases`` with bytes restored and effective
+        bandwidth — the measured baseline the peer-to-peer restore work
+        (ROADMAP item 1) starts from."""
+        import time as _time
+
+        self.last_restore_phases = {}
+        t0 = _time.monotonic()
+        with obs.span("restore_step_discovery"):
+            steps = sorted(self._manager.all_steps() or (), reverse=True)
+        discovery_s = _time.monotonic() - t0
         if not steps:
             return None
         first_exc: Optional[Exception] = None
@@ -300,8 +316,45 @@ class FlashCheckpointer:
             obs.get_registry().counter(
                 "dlrover_tpu_checkpoint_restores_total",
                 "Checkpoint restores completed").inc()
+            self.last_restore_phases["step_discovery_s"] = round(
+                discovery_s, 3)
+            self._publish_restore_stats(step)
             return result
         raise first_exc
+
+    def _publish_restore_stats(self, step: int) -> None:
+        """Bytes restored + effective read bandwidth of the step that
+        just restored, as gauges and into ``last_restore_phases``. The
+        bandwidth denominator is the tensor-read phase alone — the
+        number peer-to-peer restore has to beat."""
+        import os
+
+        phases = self.last_restore_phases
+        total_bytes = 0
+        step_dir = os.path.join(str(self._directory), str(step))
+        try:
+            for root, _, files in os.walk(step_dir):
+                total_bytes += sum(
+                    os.path.getsize(os.path.join(root, name))
+                    for name in files)
+        except OSError:
+            return
+        phases["restored_bytes"] = float(total_bytes)
+        read_s = phases.get("tensor_read_s", 0.0)
+        if read_s > 0 and total_bytes > 0:
+            phases["read_bandwidth_mbps"] = round(
+                total_bytes / (1 << 20) / read_s, 2)
+        registry = obs.get_registry()
+        registry.gauge(
+            "dlrover_tpu_checkpoint_restore_bytes",
+            "Bytes read from storage by the last checkpoint restore",
+        ).set(float(total_bytes))
+        if phases.get("read_bandwidth_mbps"):
+            registry.gauge(
+                "dlrover_tpu_checkpoint_restore_bandwidth_mbps",
+                "Effective storage bandwidth of the last restore's "
+                "tensor-read phase",
+            ).set(phases["read_bandwidth_mbps"])
 
     def _remove_failed_steps(self, steps) -> None:
         """Drop the corrupt newer steps a fallback skipped: the resumed
@@ -324,11 +377,17 @@ class FlashCheckpointer:
 
     def _restore_at(self, step: int, abstract_state: Any
                     ) -> Tuple[Any, Dict[str, Any], int]:
+        import time as _time
+
+        phases = self.last_restore_phases
         # the tiny JSON item first: it says how the state was encoded
-        data = self._manager.restore(
-            step, args=ocp.args.Composite(**{
-                _DATA_ITEM: ocp.args.JsonRestore()}),
-        )[_DATA_ITEM] or {}
+        t0 = _time.monotonic()
+        with obs.span("restore_metadata_read", {"step": step}):
+            data = self._manager.restore(
+                step, args=ocp.args.Composite(**{
+                    _DATA_ITEM: ocp.args.JsonRestore()}),
+            )[_DATA_ITEM] or {}
+        phases["metadata_read_s"] = round(_time.monotonic() - t0, 3)
         bits = int(data.pop(_QUANT_KEY, 0))
         if bits:
             from dlrover_tpu.checkpoint.quantized import (
@@ -354,36 +413,58 @@ class FlashCheckpointer:
                     "target", step, _QUANT_LAYOUT_KEY, layout)
 
             def _restore_encoded(target):
-                return self._manager.restore(
-                    step, args=ocp.args.Composite(**{
-                        _MODEL_ITEM: ocp.args.StandardRestore(target)}),
-                )[_MODEL_ITEM]
+                t_read = _time.monotonic()
+                with obs.span("restore_tensor_read",
+                              {"step": step, "quantized_bits": bits}):
+                    encoded = self._manager.restore(
+                        step, args=ocp.args.Composite(**{
+                            _MODEL_ITEM: ocp.args.StandardRestore(
+                                target)}),
+                    )[_MODEL_ITEM]
+                phases["tensor_read_s"] = round(
+                    _time.monotonic() - t_read, 3)
+                return encoded
 
             if layout == "params" and hasattr(abstract_state, "params") \
                     and hasattr(abstract_state, "replace"):
                 encoded = _restore_encoded(abstract_state.replace(
                     params=abstract_encoded(abstract_state.params,
                                             bits)))
-                state = encoded.replace(params=decode_tree(
-                    encoded.params, abstract_state.params, bits))
+                t_decode = _time.monotonic()
+                with obs.span("restore_decode", {"bits": bits}):
+                    state = encoded.replace(params=decode_tree(
+                        encoded.params, abstract_state.params, bits))
             elif (layout == "params"
                   and isinstance(abstract_state, dict)
                   and "params" in abstract_state):
                 encoded = _restore_encoded(
                     {**abstract_state, "params": abstract_encoded(
                         abstract_state["params"], bits)})
-                state = {**encoded, "params": decode_tree(
-                    encoded["params"], abstract_state["params"], bits)}
+                t_decode = _time.monotonic()
+                with obs.span("restore_decode", {"bits": bits}):
+                    state = {**encoded, "params": decode_tree(
+                        encoded["params"], abstract_state["params"],
+                        bits)}
             else:
                 # whole-tree layout: decode every encoded node in place
                 encoded = _restore_encoded(
                     abstract_encoded(abstract_state, bits))
-                state = decode_tree(encoded, abstract_state, bits)
+                t_decode = _time.monotonic()
+                with obs.span("restore_decode", {"bits": bits}):
+                    state = decode_tree(encoded, abstract_state, bits)
+            # dispatch cost only — the decoded arrays materialize under
+            # the caller's device-put/block phase
+            phases["decode_s"] = round(_time.monotonic() - t_decode, 3)
         else:
-            state = self._manager.restore(
-                step, args=ocp.args.Composite(**{
-                    _MODEL_ITEM: ocp.args.StandardRestore(abstract_state)}),
-            )[_MODEL_ITEM]
+            t_read = _time.monotonic()
+            with obs.span("restore_tensor_read", {"step": step}):
+                state = self._manager.restore(
+                    step, args=ocp.args.Composite(**{
+                        _MODEL_ITEM: ocp.args.StandardRestore(
+                            abstract_state)}),
+                )[_MODEL_ITEM]
+            phases["tensor_read_s"] = round(
+                _time.monotonic() - t_read, 3)
         logger.info("flash checkpoint: restored step %d%s", step,
                     f" (int{bits} quantized)" if bits else "")
         return state, data, step

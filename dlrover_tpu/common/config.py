@@ -96,6 +96,15 @@ class Context:
         self.diagnosis_action_cooldown_s: float = (
             DefaultValues.DIAGNOSIS_ACTION_COOLDOWN_S
         )
+        # goodput ledger alerting (obs/goodput.py, GoodputRule):
+        # threshold 0 = disabled
+        self.goodput_alert_threshold: float = (
+            DefaultValues.GOODPUT_ALERT_THRESHOLD
+        )
+        self.goodput_window_s: float = DefaultValues.GOODPUT_WINDOW_S
+        self.goodput_min_coverage: float = (
+            DefaultValues.GOODPUT_MIN_COVERAGE
+        )
         self.seconds_per_scale_check: float = (
             DefaultValues.SECONDS_PER_SCALE_CHECK
         )

@@ -280,6 +280,16 @@ class DefaultValues:
     # per-rank cooldown between dispatched actions (a straggler that
     # stays slow must not get a profile request every interval)
     DIAGNOSIS_ACTION_COOLDOWN_S = 300.0
+    # goodput alerting (obs/goodput.py + GoodputRule): alert when the
+    # productive fraction over the trailing window drops below the
+    # threshold, naming the dominant badput bucket. 0 = disabled (the
+    # default: an acceptable goodput floor is job-specific).
+    GOODPUT_ALERT_THRESHOLD = 0.0
+    GOODPUT_WINDOW_S = 600.0
+    # the window must be at least this covered (elapsed rank-seconds /
+    # window) before the rule judges it — a freshly-started world's
+    # first half-window is not evidence of lost goodput
+    GOODPUT_MIN_COVERAGE = 0.5
     # -- preemption-aware graceful drain (agent/preemption.py) ----------
     # grace window assumed when a notice carries no deadline (a bare
     # SIGTERM): k8s default terminationGracePeriodSeconds

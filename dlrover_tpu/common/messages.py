@@ -407,6 +407,11 @@ class GlobalStepReport(Message):
     # 0.0 / -1.0 = sender predates the fields or has no timeline.
     step_time_s: float = 0.0
     data_wait_fraction: float = -1.0
+    # achieved-vs-peak model-FLOPs utilization over the sender's report
+    # window (obs/mfu.py; needs the worker's FLOPs model + peak). -1.0 =
+    # sender predates the field or has no FLOPs model — the collapse
+    # rule then falls back to raw steps/s.
+    mfu: float = -1.0
 
 
 @dataclass
@@ -419,6 +424,17 @@ class ModelInfo(Message):
     flops_per_step: float = 0.0
     batch_size: int = 0
     seq_len: int = 0
+    # model-FLOPs accounting (obs/mfu.py): FLOPs per trained token
+    # (fwd+bwd, causal-discounted attention term), the sender's per-chip
+    # bf16 peak, and the global chip count its mesh spans — the master's
+    # MFU gauges are tokens/s × flops_per_token / (peak × chips).
+    # 0 = sender predates the fields.
+    flops_per_token: float = 0.0
+    peak_flops_per_chip: float = 0.0
+    chips: int = 0
+    # "analytic" (6·params formula) or "cost_analysis" (cross-checked
+    # against the compiled step's XLA cost analysis)
+    flops_source: str = ""
 
 
 # --------------------------------------------------------------------------
@@ -562,6 +578,19 @@ class DiagnosisReportRequest(Message):
 @dataclass
 class DiagnosisReports(Message):
     reports_json: str = ""       # JSON list of DiagnosisReport dicts
+
+
+@dataclass
+class GoodputRequest(Message):
+    """tools/goodput.py asking a live master for the goodput ledger
+    (window_s > 0 additionally returns a trailing-window summary)."""
+
+    window_s: float = 0.0
+
+
+@dataclass
+class GoodputReport(Message):
+    report_json: str = ""        # JSON GoodputLedger.snapshot() dict
 
 
 # --------------------------------------------------------------------------

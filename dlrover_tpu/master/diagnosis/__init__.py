@@ -6,6 +6,7 @@ from dlrover_tpu.master.diagnosis.rules import (
     DataPipelineBoundRule,
     DiagnosisReport,
     DiagnosisSnapshot,
+    GoodputRule,
     HbmPressureRule,
     StragglerRule,
     ThroughputCollapseRule,
@@ -19,6 +20,7 @@ __all__ = [
     "DiagnosisManager",
     "DiagnosisReport",
     "DiagnosisSnapshot",
+    "GoodputRule",
     "HbmPressureRule",
     "StragglerRule",
     "ThroughputCollapseRule",

@@ -54,9 +54,13 @@ _STATS_FRESH_S = 120.0
 
 
 class DiagnosisManager:
-    def __init__(self, speed_monitor, rules: Optional[List[Rule]] = None):
+    def __init__(self, speed_monitor, rules: Optional[List[Rule]] = None,
+                 goodput_ledger=None):
         self._speed_monitor = speed_monitor
         self._rules = rules if rules is not None else default_rules()
+        # optional goodput ledger (obs/goodput.py): its trailing-window
+        # summary rides on every snapshot as the GoodputRule's evidence
+        self._goodput_ledger = goodput_ledger
         self._lock = threading.Lock()
         self._diag_lock = threading.Lock()
         self._reports: deque = deque(maxlen=_REPORT_RING)
@@ -87,6 +91,11 @@ class DiagnosisManager:
             "dlrover_tpu_worker_data_wait_fraction",
             "Windowed fraction of worker step time spent waiting on "
             "data", labelnames=("node",))
+        self._mfu_gauge = registry.gauge(
+            "dlrover_tpu_worker_mfu",
+            "Windowed per-rank achieved model-FLOPs utilization (from "
+            "step reports; absent without a FLOPs model)",
+            labelnames=("node",))
 
     # -- evidence feeds (servicer threads) ---------------------------------
     def observe_resource_stats(self, stats: msg.NodeResourceStats) -> None:
@@ -206,6 +215,13 @@ class DiagnosisManager:
             stats = {rank: entry
                      for rank, entry in self._node_stats.items()
                      if now - entry["ts"] <= _STATS_FRESH_S}
+        goodput = None
+        if self._goodput_ledger is not None:
+            try:
+                goodput = self._goodput_ledger.window_summary(
+                    Context.singleton().goodput_window_s)
+            except Exception:  # noqa: BLE001 — evidence, not the chain
+                logger.exception("goodput window summary failed")
         return DiagnosisSnapshot(
             ts=now,
             worker_speeds=self._speed_monitor.worker_speeds(),
@@ -213,6 +229,9 @@ class DiagnosisManager:
             peak_speed=self._speed_monitor.peak_speed(),
             running_workers=self._speed_monitor.num_running_workers,
             node_stats=stats,
+            running_mfu=self._speed_monitor.running_mfu(),
+            peak_mfu=self._speed_monitor.peak_mfu(),
+            goodput=goodput,
         )
 
     def diagnose_once(self) -> List[DiagnosisReport]:
@@ -251,12 +270,16 @@ class DiagnosisManager:
                 self._wait_gauge.labels(node=str(rank)).set(
                     speed.data_wait_fraction)
                 published.add(rank)
+            if speed.mfu >= 0.0:
+                self._mfu_gauge.labels(node=str(rank)).set(speed.mfu)
+                published.add(rank)
         with self._lock:
             stale = self._published_scores - published
             self._published_scores = published
         for rank in stale:  # dead ranks must not keep ranking in scrapes
             self._score_gauge.remove(node=str(rank))
             self._wait_gauge.remove(node=str(rank))
+            self._mfu_gauge.remove(node=str(rank))
 
     def _emit(self, report: DiagnosisReport, ctx: Context) -> None:
         record = report.to_dict()

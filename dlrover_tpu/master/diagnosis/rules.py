@@ -51,6 +51,14 @@ class DiagnosisSnapshot:
     # worker_id -> {"cpu_percent", "memory_mb", "ts", "chips": [{...}]}
     node_stats: Dict[int, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
+    # job MFU evidence (SpeedMonitor + ModelInfo FLOPs model); -1 =
+    # no FLOPs model reported — rules fall back to raw steps/s
+    running_mfu: float = -1.0
+    peak_mfu: float = -1.0
+    # trailing-window goodput evidence (GoodputLedger.window_summary):
+    # {"goodput_fraction", "dominant_badput", "elapsed_rank_seconds",
+    #  "window_s", "buckets"}; None = no ledger attached
+    goodput: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -226,10 +234,14 @@ class DataPipelineBoundRule(Rule):
 
 
 class ThroughputCollapseRule(Rule):
-    """Windowed steps/s under ``diagnosis_collapse_ratio`` × the world's
-    observed high-water mark. The peak resets at membership change
-    (SpeedMonitor.reset_running_speed), so a deliberate scale-down is a
-    new baseline, not a collapse."""
+    """Windowed MFU (preferred) or steps/s under
+    ``diagnosis_collapse_ratio`` × the world's observed high-water mark.
+    MFU is the better collapse signal once a FLOPs model is reported:
+    it is what the fleet actually pays for, and a report phrased as
+    "MFU 0.18 vs peak 0.63" is directly actionable where raw tokens/s
+    needs the model size for context. The peak resets at membership
+    change (SpeedMonitor.reset_running_speed), so a deliberate
+    scale-down is a new baseline, not a collapse."""
 
     name = "throughput_collapse"
 
@@ -238,24 +250,89 @@ class ThroughputCollapseRule(Rule):
 
     def evaluate(self, snapshot, ctx=None):
         ctx = ctx or Context.singleton()
-        if snapshot.peak_speed <= 0.0 or snapshot.running_speed <= 0.0:
+        if snapshot.peak_mfu > 0.0 and snapshot.running_mfu >= 0.0:
+            running, peak = snapshot.running_mfu, snapshot.peak_mfu
+            evidence = (f"MFU {running:.3f} vs this world's peak "
+                        f"{peak:.3f}")
+            details = {"running_mfu": round(running, 4),
+                       "peak_mfu": round(peak, 4), "signal": "mfu"}
+        else:
+            running, peak = snapshot.running_speed, snapshot.peak_speed
+            evidence = (f"{running:.2f} vs {peak:.2f} steps/s")
+            details = {"running_speed": round(running, 4),
+                       "peak_speed": round(peak, 4),
+                       "signal": "steps_per_second"}
+        if peak <= 0.0 or running <= 0.0:
             return []
-        ratio = snapshot.running_speed / snapshot.peak_speed
+        ratio = running / peak
         if ratio < ctx.diagnosis_collapse_ratio:
             if self._collapsed:
                 return []
             self._collapsed = True
+            details["ratio"] = round(ratio, 3)
             return [DiagnosisReport(
                 rule=self.name, severity=CRITICAL,
                 summary=(f"throughput collapsed to {ratio:.0%} of this "
-                         f"world's peak ({snapshot.running_speed:.2f} vs "
-                         f"{snapshot.peak_speed:.2f} steps/s)"),
-                details={"running_speed": round(snapshot.running_speed, 4),
-                         "peak_speed": round(snapshot.peak_speed, 4),
-                         "ratio": round(ratio, 3)},
+                         f"world's peak ({evidence})"),
+                details=details,
                 actions=[ACTION_ALERT],
             )]
         self._collapsed = False
+        return []
+
+
+class GoodputRule(Rule):
+    """Trailing-window goodput under ``goodput_alert_threshold``: the
+    job is spending its rank-seconds on something other than productive
+    steps, and the report names the dominant badput bucket so the alert
+    is actionable (restore-bound vs compile-bound vs data-wait demand
+    different fixes). Disabled by default (threshold 0 — an acceptable
+    floor is job-specific); the window must be at least
+    ``goodput_min_coverage`` covered before judging, so a fresh world's
+    first minutes are not evidence."""
+
+    name = "goodput"
+
+    def __init__(self):
+        self._alerted = False
+
+    def evaluate(self, snapshot, ctx=None):
+        ctx = ctx or Context.singleton()
+        threshold = ctx.goodput_alert_threshold
+        evidence = snapshot.goodput
+        if threshold <= 0.0 or not evidence:
+            return []
+        window_s = float(evidence.get("window_s", 0.0))
+        elapsed = float(evidence.get("elapsed_rank_seconds", 0.0))
+        workers = max(1, snapshot.running_workers)
+        if window_s <= 0.0 or \
+                elapsed < ctx.goodput_min_coverage * window_s * workers:
+            return []
+        fraction = float(evidence.get("goodput_fraction", -1.0))
+        if fraction < 0.0:
+            return []
+        if fraction < threshold:
+            if self._alerted:
+                return []
+            self._alerted = True
+            dominant = evidence.get("dominant_badput") or "idle"
+            dominant_s = float(evidence.get("dominant_badput_s", 0.0))
+            return [DiagnosisReport(
+                rule=self.name, severity=CRITICAL,
+                summary=(
+                    f"goodput {fraction:.0%} over the last "
+                    f"{window_s:.0f}s is below the {threshold:.0%} "
+                    f"floor; dominant badput: {dominant} "
+                    f"({dominant_s:.0f}s)"),
+                details={"goodput_fraction": round(fraction, 4),
+                         "threshold": threshold,
+                         "window_s": window_s,
+                         "dominant_badput": dominant,
+                         "dominant_badput_s": round(dominant_s, 1),
+                         "buckets": dict(evidence.get("buckets", {}))},
+                actions=[ACTION_ALERT],
+            )]
+        self._alerted = False
         return []
 
 
@@ -300,7 +377,7 @@ class HbmPressureRule(Rule):
 def default_rules() -> List[Rule]:
     """The chain, cheapest-evidence first."""
     return [StragglerRule(), DataPipelineBoundRule(),
-            ThroughputCollapseRule(), HbmPressureRule()]
+            ThroughputCollapseRule(), HbmPressureRule(), GoodputRule()]
 
 
 def parse_action(action: str) -> Dict[str, Any]:

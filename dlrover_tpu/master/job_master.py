@@ -72,11 +72,17 @@ class JobMaster:
         self.sync_service = SyncService(expected_workers=min_nodes)
         self.elastic_ps_service = ElasticPsService()
         self.job_manager = job_manager
+        # the goodput ledger classifies every rank-second of the job
+        # (obs/goodput.py); fed by the servicer, persisted with the
+        # control-plane state, queried over RPC by tools/goodput.py
+        self.goodput_ledger = obs.GoodputLedger()
         self.diagnosis_manager = None
         if ctx.diagnosis_enabled:
             from dlrover_tpu.master.diagnosis import DiagnosisManager
 
-            self.diagnosis_manager = DiagnosisManager(self.speed_monitor)
+            self.diagnosis_manager = DiagnosisManager(
+                self.speed_monitor,
+                goodput_ledger=self.goodput_ledger)
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             rdzv_managers=self.rdzv_managers,
@@ -86,6 +92,7 @@ class JobMaster:
             elastic_ps_service=self.elastic_ps_service,
             job_manager=job_manager,
             diagnosis_manager=self.diagnosis_manager,
+            goodput_ledger=self.goodput_ledger,
         )
         self._host = host
         self._server, self.port = build_server(
@@ -174,6 +181,7 @@ class JobMaster:
             "task_manager": self.task_manager.export_state(),
             "kv_store": self.kv_store.export_state(),
             "speed_monitor": self.speed_monitor.export_state(),
+            "goodput": self.goodput_ledger.export_state(),
         }
         if self.diagnosis_manager is not None:
             state["diagnosis"] = self.diagnosis_manager.export_state()
@@ -191,6 +199,8 @@ class JobMaster:
         self.task_manager.restore_state(state.get("task_manager", {}))
         self.kv_store.restore_state(state.get("kv_store", {}))
         self.speed_monitor.restore_state(state.get("speed_monitor", {}))
+        if "goodput" in state:
+            self.goodput_ledger.restore_state(state["goodput"])
         if self.diagnosis_manager is not None and "diagnosis" in state:
             self.diagnosis_manager.restore_state(state["diagnosis"])
         if self.job_manager is not None and "job_manager" in state and \
@@ -414,7 +424,11 @@ class JobMaster:
             # a coalesced mutation must not die with the process when
             # the stop is graceful
             self._maybe_snapshot(force=True)
-            # the master's half of the postmortem timeline
+            # the master's half of the postmortem timeline; the goodput
+            # snapshot rides in the dump so `tools/goodput.py --flight`
+            # renders the ledger from the postmortem alone
+            self.goodput_ledger.record_flight_snapshot(
+                reason="master-stop")
             obs.get_flight_recorder().record_event(
                 "master_stop", exit_reason=self._exit_reason)
             obs.get_flight_recorder().dump(reason="master-stop")

@@ -59,6 +59,7 @@ class MasterServicer:
         job_manager=None,
         metric_collector=None,
         diagnosis_manager=None,
+        goodput_ledger=None,
     ):
         self.task_manager = task_manager or TaskManager()
         self.rdzv_managers: Dict[str, RendezvousManager] = rdzv_managers or {
@@ -74,6 +75,9 @@ class MasterServicer:
         # optional: the diagnosis engine (master/diagnosis/) — fed from
         # step/resource reports, drained by agent action polls
         self.diagnosis_manager = diagnosis_manager
+        # optional: the goodput ledger (obs/goodput.py) — fed from step
+        # reports, telemetry spans and drain/failure handlers
+        self.goodput_ledger = goodput_ledger
         self._paral_config = msg.ParallelConfig()
         self._start_time = time.time()
         # crash-consistency hook (wired by JobMaster): called after any
@@ -132,6 +136,11 @@ class MasterServicer:
             rdzv_round, group, world = mgr.get_comm_world(request.node_id)
             if mgr.mutation_count != before:
                 self._sink_state()
+            if (self.goodput_ledger is not None and world
+                    and request.rdzv_name == RendezvousName.TRAINING):
+                # a cut training world: the ledger opens an incarnation
+                # per new round (idempotent for repeat polls)
+                self.goodput_ledger.observe_world(rdzv_round, len(world))
             return msg.CommWorld(rdzv_name=request.rdzv_name,
                                  round=rdzv_round, group=group, world=world)
         if isinstance(request, msg.WaitingNodeNumRequest):
@@ -161,6 +170,14 @@ class MasterServicer:
                 reports = self.diagnosis_manager.reports(request.limit)
             return msg.DiagnosisReports(
                 reports_json=DiagnosisManager.reports_to_json(reports))
+        if isinstance(request, msg.GoodputRequest):
+            import json
+
+            if self.goodput_ledger is None:
+                return msg.GoodputReport(report_json="")
+            return msg.GoodputReport(report_json=json.dumps(
+                self.goodput_ledger.snapshot(
+                    window_s=request.window_s)))
         if isinstance(request, msg.KVGetRequest):
             return msg.KeyValuePair(key=request.key,
                                     value=self.kv_store.get(request.key))
@@ -256,12 +273,20 @@ class MasterServicer:
             # keyed by RANK when the sender provides one: diagnosis
             # actions address agents by rank (node_id diverges from rank
             # after a relaunch), so the straggler evidence must too
+            rank = (request.node_rank if request.node_rank >= 0
+                    else request.node_id)
             self.speed_monitor.collect_worker_step(
-                request.node_rank if request.node_rank >= 0
-                else request.node_id,
+                rank,
                 request.step,
                 step_time_s=request.step_time_s,
-                data_wait_fraction=request.data_wait_fraction)
+                data_wait_fraction=request.data_wait_fraction,
+                mfu=request.mfu)
+            if self.goodput_ledger is not None:
+                self.goodput_ledger.observe_step_report(
+                    rank, request.step,
+                    step_time_s=request.step_time_s,
+                    data_wait_fraction=request.data_wait_fraction,
+                    mfu=request.mfu)
             self._touch_rendezvous(request.node_rank)
             # deliberately NOT a snapshot trigger (the per-step hot
             # path); the step high-water mark rides on the next
@@ -298,6 +323,19 @@ class MasterServicer:
                     request.node_rank if request.node_rank >= 0
                     else request.node_id,
                     request.exit_kind, detail=request.error_data[:128])
+            if self.goodput_ledger is not None:
+                from dlrover_tpu.common.constants import NodeExitReason
+
+                failed_rank = (request.node_rank
+                               if request.node_rank >= 0
+                               else request.node_id)
+                if request.exit_kind == NodeExitReason.HANG:
+                    self.goodput_ledger.observe_hang(
+                        failed_rank,
+                        Context.singleton().hang_watchdog_s)
+                elif request.exit_kind != NodeExitReason.DRAINED:
+                    self.goodput_ledger.note_elasticity_event(
+                        "worker_lost")
         elif isinstance(request, msg.NodeAddressReport):
             self.kv_store.set(f"node-addr/{request.node_rank}",
                               request.addr.encode())
@@ -328,6 +366,10 @@ class MasterServicer:
             # tokens/s exposition = steps/s × tokens-per-step
             self.speed_monitor.set_tokens_per_step(
                 request.batch_size * request.seq_len)
+            # MFU exposition = tokens/s × FLOPs/token / aggregate peak
+            self.speed_monitor.set_model_flops(
+                request.flops_per_token,
+                request.peak_flops_per_chip * max(1, request.chips))
         elif isinstance(request, msg.TelemetryReport):
             self._ingest_telemetry(request)
         else:
@@ -388,12 +430,18 @@ class MasterServicer:
         checkpoint_ranks = []
         if request.phase == "complete":
             announced = False
+            if self.goodput_ledger is not None:
+                # notice → departure is drain badput; the rank's
+                # lifetime in the ledger ends here
+                self.goodput_ledger.complete_drain(rank)
             for mgr in self.rdzv_managers.values():
                 announced = mgr.complete_drain(rank) or announced
                 self._evict_departed(mgr)
             logger.info("node %d drain COMPLETE (announced=%s): "
                         "survivors re-form now", rank, announced)
         else:
+            if self.goodput_ledger is not None:
+                self.goodput_ledger.mark_draining(rank, request.deadline)
             planned = {}
             for name, mgr in self.rdzv_managers.items():
                 world = mgr.mark_draining(rank, request.deadline)
@@ -473,6 +521,11 @@ class MasterServicer:
                 return
             if isinstance(spans, list):
                 obs.record_remote_spans(spans, registry)
+                if self.goodput_ledger is not None:
+                    for record in spans:
+                        if isinstance(record, dict):
+                            self.goodput_ledger.observe_span(
+                                record, rank=report.node_rank)
 
     # ------------------------------------------------------------------
     def _evict_departed(self, mgr) -> None:
@@ -483,6 +536,8 @@ class MasterServicer:
         self.speed_monitor.evict_departed(live)
         if self.diagnosis_manager is not None:
             self.diagnosis_manager.evict_workers(live)
+        if self.goodput_ledger is not None:
+            self.goodput_ledger.evict(live)
 
     # ------------------------------------------------------------------
     def _touch_rendezvous(self, node_rank: int) -> None:

@@ -39,6 +39,7 @@ class WorkerSpeed:
     data_wait_fraction: float = -1.0   # -1 = no timeline evidence
     last_report_ts: float = 0.0
     step: int = 0
+    mfu: float = -1.0                  # -1 = no FLOPs model evidence
 
 
 class SpeedMonitor:
@@ -53,17 +54,21 @@ class SpeedMonitor:
         self._last_step_time: float = time.time()
         self._workers: Set[int] = set()
         self._worker_steps: Dict[int, int] = {}
-        # worker_id -> deque[(step_time_s, data_wait_fraction, ts)] from
-        # step reports that carried timing evidence
+        # worker_id -> deque[(step_time_s, data_wait_fraction, mfu, ts)]
+        # from step reports that carried timing evidence
         self._worker_window = max(2, ctx.diagnosis_worker_window)
-        self._worker_times: Dict[int, Deque[Tuple[float, float, float]]] \
-            = {}
+        self._worker_times: Dict[
+            int, Deque[Tuple[float, float, float, float]]] = {}
         # steps/s high-water mark over the job (throughput-collapse
         # baseline; survives window resets, cleared on restore)
         self._peak_speed = 0.0
         self._start_training_time: Optional[float] = None
         self._paused_time_s: float = 0.0
         self._tokens_per_step: int = 0
+        # model-FLOPs accounting (obs/mfu.py, fed by ModelInfo): the
+        # job's MFU exposition is tokens/s × flops_per_token / peak
+        self._flops_per_token: float = 0.0
+        self._peak_flops_total: float = 0.0
         # set at membership change: the NEXT step-report delta spans the
         # failover gap (rendezvous + recompile + restore), not step time
         self._skip_next_step_time = False
@@ -90,6 +95,15 @@ class SpeedMonitor:
             "dlrover_tpu_training_running_workers",
             "Workers currently joined on the master",
         ).set_function(lambda: self.num_running_workers)
+        registry.gauge(
+            "dlrover_tpu_training_mfu",
+            "Job model-FLOPs utilization: tokens/s x FLOPs-per-token "
+            "over the world's aggregate peak (-1 = no FLOPs model yet)",
+        ).set_function(self.running_mfu)
+        registry.gauge(
+            "dlrover_tpu_training_model_flops_per_token",
+            "Model FLOPs per trained token (ModelInfo; obs/mfu.py)",
+        ).set_function(lambda: self._model_flops())
         self._step_time_hist = registry.histogram(
             "dlrover_tpu_train_step_time_seconds",
             "Wall-clock per training step, from step-report deltas",
@@ -124,6 +138,7 @@ class SpeedMonitor:
     def collect_worker_step(self, worker_id: int, step: int,
                             step_time_s: float = 0.0,
                             data_wait_fraction: float = -1.0,
+                            mfu: float = -1.0,
                             timestamp: Optional[float] = None) -> None:
         timestamp = timestamp or time.time()
         with self._lock:
@@ -133,7 +148,8 @@ class SpeedMonitor:
                 if window is None:
                     window = deque(maxlen=self._worker_window)
                     self._worker_times[worker_id] = window
-                window.append((step_time_s, data_wait_fraction, timestamp))
+                window.append((step_time_s, data_wait_fraction, mfu,
+                               timestamp))
         self.collect_global_step(step, timestamp)
 
     def set_start_training(self) -> None:
@@ -147,6 +163,20 @@ class SpeedMonitor:
         with self._lock:
             if tokens > 0:
                 self._tokens_per_step = int(tokens)
+
+    def set_model_flops(self, flops_per_token: float,
+                        peak_flops_total: float) -> None:
+        """From ModelInfo: the FLOPs model + aggregate peak that turn the
+        tokens/s series into the MFU gauge."""
+        with self._lock:
+            if flops_per_token > 0.0:
+                self._flops_per_token = float(flops_per_token)
+            if peak_flops_total > 0.0:
+                self._peak_flops_total = float(peak_flops_total)
+
+    def _model_flops(self) -> float:
+        with self._lock:
+            return self._flops_per_token
 
     # -- queries -----------------------------------------------------------
     @property
@@ -179,6 +209,30 @@ class SpeedMonitor:
         with self._lock:
             return self._peak_speed
 
+    def running_mfu(self) -> float:
+        """Job MFU from the windowed throughput; -1 with no FLOPs
+        model (callers must not mistake "no evidence" for 0%)."""
+        from dlrover_tpu.obs import mfu as mfu_math
+
+        with self._lock:
+            tokens = self._tokens_per_step
+            fpt = self._flops_per_token
+            peak = self._peak_flops_total
+        return mfu_math.achieved_mfu(self.running_speed() * tokens,
+                                     fpt, peak)
+
+    def peak_mfu(self) -> float:
+        """MFU at this world's steps/s high-water mark (the collapse
+        rule's MFU baseline); -1 with no FLOPs model."""
+        from dlrover_tpu.obs import mfu as mfu_math
+
+        with self._lock:
+            tokens = self._tokens_per_step
+            fpt = self._flops_per_token
+            peak = self._peak_flops_total
+            peak_speed = self._peak_speed
+        return mfu_math.achieved_mfu(peak_speed * tokens, fpt, peak)
+
     def worker_speeds(self) -> Dict[int, WorkerSpeed]:
         """Windowed per-worker means for the diagnosis engine (only
         workers whose reports carried timing evidence appear)."""
@@ -187,16 +241,18 @@ class SpeedMonitor:
             for worker_id, window in self._worker_times.items():
                 if not window:
                     continue
-                times = [t for t, _, _ in window]
-                waits = [w for _, w, _ in window if w >= 0.0]
+                times = [t for t, _, _, _ in window]
+                waits = [w for _, w, _, _ in window if w >= 0.0]
+                mfus = [m for _, _, m, _ in window if m >= 0.0]
                 out[worker_id] = WorkerSpeed(
                     worker_id=worker_id,
                     samples=len(window),
                     mean_step_time_s=sum(times) / len(times),
                     data_wait_fraction=(sum(waits) / len(waits)
                                         if waits else -1.0),
-                    last_report_ts=window[-1][2],
+                    last_report_ts=window[-1][3],
                     step=self._worker_steps.get(worker_id, 0),
+                    mfu=(sum(mfus) / len(mfus) if mfus else -1.0),
                 )
             return out
 
@@ -246,7 +302,9 @@ class SpeedMonitor:
     def export_state(self) -> dict:
         with self._lock:
             return {"global_step": self._global_step,
-                    "tokens_per_step": self._tokens_per_step}
+                    "tokens_per_step": self._tokens_per_step,
+                    "flops_per_token": self._flops_per_token,
+                    "peak_flops_total": self._peak_flops_total}
 
     def restore_state(self, state: dict) -> None:
         """Rehydrate the step high-water mark so post-failover hang
@@ -256,6 +314,10 @@ class SpeedMonitor:
         with self._lock:
             self._global_step = int(state.get("global_step", 0))
             self._tokens_per_step = int(state.get("tokens_per_step", 0))
+            self._flops_per_token = float(
+                state.get("flops_per_token", 0.0))
+            self._peak_flops_total = float(
+                state.get("peak_flops_total", 0.0))
             self._last_step_time = time.time()
             self._samples.clear()
             self._skip_next_step_time = True

@@ -21,10 +21,18 @@ from __future__ import annotations
 
 import threading
 
+from dlrover_tpu.obs import mfu
 from dlrover_tpu.obs.flight_recorder import (
     FLIGHT_DIR_ENV,
     FlightRecorder,
     get_flight_recorder,
+)
+from dlrover_tpu.obs.goodput import (
+    BADPUT_BUCKETS,
+    BUCKETS,
+    GoodputLedger,
+    render_snapshot,
+    snapshot_from_flight,
 )
 from dlrover_tpu.obs.metrics import (
     DEFAULT_BUCKETS,
@@ -51,9 +59,12 @@ from dlrover_tpu.obs.spans import (
 from dlrover_tpu.obs.timeline import StepTimeline, load_timeline
 
 __all__ = [
+    "BADPUT_BUCKETS",
+    "BUCKETS",
     "DEFAULT_BUCKETS",
     "FLIGHT_DIR_ENV",
     "FlightRecorder",
+    "GoodputLedger",
     "MetricsRegistry",
     "ProfilerCapture",
     "ProfilerSession",
@@ -66,11 +77,14 @@ __all__ = [
     "get_flight_recorder",
     "get_registry",
     "load_timeline",
+    "mfu",
     "publish_node_stats",
     "read_profile_result",
     "record_remote_spans",
     "record_span",
     "remove_span_sink",
+    "render_snapshot",
+    "snapshot_from_flight",
     "span",
     "start_http_exporter",
     "write_profile_request",

@@ -184,13 +184,20 @@ class ElasticTrainLoop:
             self.dp, self.accum, self.micro_global,
             dict(self.mesh.shape),
         )
-        self._report_model_info()
+        # MFU accounting (obs/mfu.py): FLOPs/token + the mesh's
+        # aggregate peak; 0 until _report_model_info derives them
+        self._flops_per_token = 0.0
+        self._peak_flops_total = 0.0
+        self._flops_cross_checked = False
+        self._report_model_info(model)
 
-    def _report_model_info(self) -> None:
+    def _report_model_info(self, model=None) -> None:
         """One-shot static stats to the master's resource optimizer
-        (reference: profile_extractor → ModelInfo)."""
-        if self.client is None:
-            return
+        (reference: profile_extractor → ModelInfo) plus the FLOPs model
+        behind every MFU number (obs/mfu.py): analytic 6·params with
+        the causal attention term when the model config exposes its
+        shape, cross-checked later against the compiled step's XLA cost
+        analysis (_maybe_cross_check_flops)."""
         try:
             abstract = self.trainer.abstract_state(jax.random.PRNGKey(0))
             leaves = jax.tree.leaves(abstract.params)
@@ -198,14 +205,88 @@ class ElasticTrainLoop:
             param_bytes = sum(
                 int(np.prod(l.shape)) * l.dtype.itemsize for l in leaves)
             tokens_per_step = self.config.global_batch * self.config.seq_len
+            cfg = getattr(model, "config", None)
+            self._param_count = param_count
+            self._param_bytes = param_bytes
+            # same accounting as bench.py: a gather-lookup embedding
+            # table with an untied head does no matmul — crediting it
+            # would report a higher MFU than the bench measures for the
+            # identical model
+            uncounted = 0.0
+            if (getattr(cfg, "embed_impl", "") == "gather"
+                    and not getattr(cfg, "tie_embeddings", True)):
+                uncounted = (getattr(cfg, "vocab_size", 0)
+                             * getattr(cfg, "hidden_size", 0))
+            self._flops_per_token = obs.mfu.flops_per_token(
+                param_count,
+                num_layers=getattr(cfg, "num_layers", 0),
+                hidden_size=getattr(cfg, "hidden_size", 0),
+                seq_len=self.config.seq_len,
+                uncounted_embed_params=uncounted,
+            )
+            device = jax.devices()[0]
+            peak_chip = obs.mfu.peak_flops_per_chip(
+                getattr(device, "device_kind", ""),
+                backend=jax.default_backend())
+            chips = jax.device_count()
+            self._peak_flops_total = peak_chip * max(1, chips)
+            if self.client is None:
+                return
             self.client.report_model_info(
                 param_count=param_count, param_bytes=param_bytes,
-                flops_per_step=6.0 * param_count * tokens_per_step,
+                flops_per_step=self._flops_per_token * tokens_per_step,
                 batch_size=self.config.global_batch,
                 seq_len=self.config.seq_len,
+                flops_per_token=self._flops_per_token,
+                peak_flops_per_chip=peak_chip,
+                chips=chips,
+                flops_source="analytic",
             )
         except Exception:   # noqa: BLE001 — stats are advisory
             logger.warning("model-info report failed", exc_info=True)
+
+    def _maybe_cross_check_flops(self) -> None:
+        """Once, after the step is AOT-compiled: cross-check the
+        analytic FLOPs/token against XLA's cost analysis of the actual
+        program. On a >2x divergence (an exotic model the 6·params
+        formula misjudges) the measured value is adopted and
+        re-reported, so MFU gauges track what the hardware really
+        executes."""
+        if self._flops_cross_checked:
+            return
+        compiled = getattr(self.trainer, "_compiled_step", None)
+        if compiled is None:
+            return
+        self._flops_cross_checked = True
+        measured = obs.mfu.cost_analysis_flops(compiled)
+        tokens_per_step = self.config.global_batch * self.config.seq_len
+        adopted = obs.mfu.cross_check(self._flops_per_token, measured,
+                                      tokens_per_step)
+        if adopted is None:
+            return
+        logger.warning(
+            "FLOPs model cross-check: analytic %.3e/token vs XLA cost "
+            "analysis %.3e/token — adopting the measured value",
+            self._flops_per_token, adopted)
+        self._flops_per_token = adopted
+        if self.client is not None:
+            try:
+                device = jax.devices()[0]
+                self.client.report_model_info(
+                    param_count=getattr(self, "_param_count", 0),
+                    param_bytes=getattr(self, "_param_bytes", 0),
+                    flops_per_step=adopted * tokens_per_step,
+                    batch_size=self.config.global_batch,
+                    seq_len=self.config.seq_len,
+                    flops_per_token=adopted,
+                    peak_flops_per_chip=obs.mfu.peak_flops_per_chip(
+                        getattr(device, "device_kind", ""),
+                        backend=jax.default_backend()),
+                    chips=jax.device_count(),
+                    flops_source="cost_analysis",
+                )
+            except Exception:  # noqa: BLE001 — stats are advisory
+                pass
 
     # -- signals -----------------------------------------------------------
     def install_signal_handler(self) -> None:
@@ -258,6 +339,13 @@ class ElasticTrainLoop:
                 t0 = _time.monotonic()
                 restored = self.checkpointer.restore(abstract)
                 timings["orbax_read_s"] = round(_time.monotonic() - t0, 2)
+                # the checkpointer's own per-phase decomposition (step
+                # discovery / metadata / tensor read / decode, bytes +
+                # bandwidth) nests under orbax_read_s
+                for key, value in getattr(self.checkpointer,
+                                          "last_restore_phases",
+                                          {}).items():
+                    timings[f"restore_{key}"] = value
                 if restored is None:
                     state, step = self.trainer.init(rng), 0
                 else:
@@ -266,18 +354,28 @@ class ElasticTrainLoop:
                     # transfer (remote-execution backends materialize
                     # lazily)
                     t0 = _time.monotonic()
-                    jax.block_until_ready(state)
+                    with obs.span("restore_device_put", {"step": step}):
+                        jax.block_until_ready(state)
                     timings["device_ready_s"] = round(
                         _time.monotonic() - t0, 2)
-                    if sampler is not None and "sampler" in data_state:
-                        sampler.load_state_dict(data_state["sampler"])
-                    if self.client is not None and data_state.get("shards"):
-                        try:
-                            self.client.report_shard_checkpoint(
-                                data_state["shards"])
-                        except Exception:
-                            logger.warning(
-                                "could not restore master shard checkpoint")
+                    # post-restore host sync: data position back into
+                    # the sampler + the master's shard checkpoint
+                    t0 = _time.monotonic()
+                    with obs.span("restore_post_sync", {"step": step}):
+                        if sampler is not None and \
+                                "sampler" in data_state:
+                            sampler.load_state_dict(data_state["sampler"])
+                        if self.client is not None and \
+                                data_state.get("shards"):
+                            try:
+                                self.client.report_shard_checkpoint(
+                                    data_state["shards"])
+                            except Exception:
+                                logger.warning(
+                                    "could not restore master shard "
+                                    "checkpoint")
+                    timings["post_sync_s"] = round(
+                        _time.monotonic() - t0, 2)
             if compile_thread is not None:
                 t0 = _time.monotonic()
                 compile_thread.join()
@@ -492,10 +590,18 @@ class ElasticTrainLoop:
         stats = self.timeline.window_stats(
             self.config.report_interval_steps)
         mean_step = stats.get("mean_step_s", 0.0)
+        # achieved-vs-peak over the window: the step report's MFU field
+        # feeds the master's per-rank gauge and the collapse rule
+        self._maybe_cross_check_flops()
+        tokens_per_step = self.config.global_batch * self.config.seq_len
+        mfu = obs.mfu.achieved_mfu(
+            tokens_per_step / mean_step if mean_step > 0 else -1.0,
+            self._flops_per_token, self._peak_flops_total)
         try:
             self.client.report_global_step(
                 step, step_time_s=mean_step,
-                data_wait_fraction=stats.get("data_wait_fraction", -1.0))
+                data_wait_fraction=stats.get("data_wait_fraction", -1.0),
+                mfu=mfu)
         except Exception:  # noqa: BLE001 — droppable by contract
             pass
         # tail-only AND wall-clock throttled on the hot path: the

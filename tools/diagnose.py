@@ -19,8 +19,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 _PHASE_ORDER = ("data_wait", "h2d", "compute", "host_sync",
                 "checkpoint", "other")
@@ -102,6 +106,27 @@ def render_lifecycle(payload: Dict[str, Any]) -> str:
             name=str(record.get("name", "?")),
             detail=detail).rstrip())
     return "\n".join(lines)
+
+
+def render_goodput(payload: Dict[str, Any]) -> str:
+    """Goodput-ledger section of a flight dump: the bucket split plus
+    the per-incarnation badput attribution (obs/goodput.py). Dumps
+    predating the ledger render an empty section."""
+    try:
+        from dlrover_tpu.obs.goodput import (
+            render_snapshot,
+            snapshot_from_flight,
+        )
+    except ImportError:
+        return "goodput ledger: unavailable (dlrover_tpu not on path)"
+    snap = snapshot_from_flight(payload)
+    if snap is None:
+        return "goodput ledger: no evidence in dump"
+    prefix = ""
+    if snap.get("rebuilt_from_spans"):
+        prefix = ("(no goodput snapshot in dump: rebuilt from spans — "
+                  "productive time unavailable, reads as idle)\n")
+    return prefix + render_snapshot(snap)
 
 
 def render_timeline(payload: Dict[str, Any], last: int = 0) -> str:
@@ -188,6 +213,7 @@ def main(argv=None) -> int:
         print(f"== {path}")
         print(render_reports(reports_from_flight(payload)))
         print(render_lifecycle(payload))
+        print(render_goodput(payload))
     for path in ns.timeline:
         payload = _load_json(path)
         if payload is None:

@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""Render the goodput ledger: where did the job's wall-clock go?
+
+Two sources, one view (obs/goodput.py):
+
+    # a live master (GoodputRequest RPC), optionally with a trailing
+    # window summary
+    python tools/goodput.py --master 10.0.0.2:50051 [--window 3600]
+
+    # a flight-recorder dump (the master records a `goodput` snapshot
+    # event on stop; older dumps are approximated from their spans —
+    # productive time is then unavailable and reads as idle)
+    python tools/goodput.py --flight flight-master-7.json
+
+Output: job-wide bucket split (productive / data_wait / compile /
+rendezvous / restore / checkpoint_stall / drain / hang / idle), per-rank
+rows with current state and windowed MFU, and the per-incarnation
+"time lost to elasticity events" attribution.
+
+Exit codes: 0 ok; 2 on unreadable inputs / unreachable master /
+dumps with no goodput evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "goodput", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--master", default="",
+                        help="live master address (host:port)")
+    parser.add_argument("--flight", nargs="*", default=[],
+                        help="flight-recorder dump file(s)")
+    parser.add_argument("--window", type=float, default=0.0,
+                        help="also summarize the trailing N seconds "
+                             "(live master only)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the raw snapshot JSON instead of "
+                             "the rendered report")
+    ns = parser.parse_args(argv)
+    if not (ns.master or ns.flight):
+        parser.error("one of --master / --flight is required")
+
+    from dlrover_tpu.obs.goodput import render_snapshot, snapshot_from_flight
+
+    status = 0
+    if ns.master:
+        try:
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            client = MasterClient(ns.master, node_id=-1)
+            try:
+                snap = client.get_goodput(window_s=ns.window)
+            finally:
+                client.close()
+            if not snap:
+                print(f"master {ns.master}: no goodput ledger",
+                      file=sys.stderr)
+                status = 2
+            else:
+                print(json.dumps(snap) if ns.json
+                      else render_snapshot(snap))
+        except Exception as e:  # noqa: BLE001 — transport errors vary
+            print(f"master {ns.master}: unreachable: {e}",
+                  file=sys.stderr)
+            status = 2
+    for path in ns.flight:
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{path}: unreadable dump: {e}", file=sys.stderr)
+            status = 2
+            continue
+        snap = snapshot_from_flight(payload)
+        if snap is None:
+            print(f"{path}: no goodput snapshot or spans in dump",
+                  file=sys.stderr)
+            status = 2
+            continue
+        if len(ns.flight) > 1:
+            print(f"== {path}")
+        if snap.get("rebuilt_from_spans"):
+            print("(no goodput snapshot in dump: rebuilt from spans — "
+                  "productive time unavailable, reads as idle)")
+        print(json.dumps(snap) if ns.json else render_snapshot(snap))
+    return status
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,6 +25,24 @@ def _fmt_attrs(attrs: dict) -> str:
     return " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
 
 
+def _render_goodput_tail(payload: dict) -> list:
+    """Goodput-ledger section appended after the record listing when
+    the dump carries a snapshot event (obs/goodput.py). Best-effort:
+    this tool must keep working on dumps read outside the repo."""
+    snapshots = [r for r in payload.get("events", [])
+                 if r.get("kind") == "event"
+                 and r.get("name") == "goodput"
+                 and isinstance(r.get("attrs", {}).get("snapshot"),
+                                dict)]
+    if not snapshots:
+        return []
+    try:
+        from dlrover_tpu.obs.goodput import render_snapshot
+    except ImportError:
+        return []
+    return ["", render_snapshot(snapshots[-1]["attrs"]["snapshot"])]
+
+
 def render(payload: dict, spans_only: bool = False,
            name_filter: str = "") -> str:
     events = payload.get("events", [])
@@ -50,7 +68,19 @@ def render(payload: dict, spans_only: bool = False,
             continue
         shown += 1
         offset = record.get("ts", 0.0) - t0
-        attrs = _fmt_attrs(record.get("attrs", {}))
+        record_attrs = record.get("attrs", {})
+        if name == "goodput" and isinstance(
+                record_attrs.get("snapshot"), dict):
+            # the full ledger renders as its own section below; the
+            # inline row gets a one-line summary
+            snap = record_attrs["snapshot"]
+            record_attrs = {
+                "goodput_fraction": snap.get("goodput_fraction"),
+                "elapsed_rank_seconds": snap.get(
+                    "elapsed_rank_seconds"),
+                "reason": record_attrs.get("reason", ""),
+            }
+        attrs = _fmt_attrs(record_attrs)
         if kind == "span":
             duration = record.get("duration_s", 0.0)
             status = record.get("status", "ok")
@@ -64,6 +94,8 @@ def render(payload: dict, spans_only: bool = False,
     if name_filter or spans_only:
         lines.append("")
         lines.append(f"shown: {shown}/{len(events)}")
+    if not (spans_only or name_filter):
+        lines.extend(_render_goodput_tail(payload))
     return "\n".join(lines)
 
 
