@@ -131,6 +131,9 @@ def _fold_restore_fields(result: dict, restore_result: dict) -> None:
     the episode's rank-time was productive."""
     breakdown = restore_result.get("breakdown") or {}
     for source, target in (
+            ("peer_plan_s", "restore_peer_plan_s"),
+            ("peer_transfer_s", "restore_peer_transfer_s"),
+            ("peer_bandwidth_mbps", "restore_peer_bandwidth_mbps"),
             ("orbax_read_s", "restore_orbax_read_s"),
             ("restore_metadata_read_s", "restore_metadata_read_s"),
             ("restore_tensor_read_s", "restore_tensor_read_s"),
@@ -147,7 +150,7 @@ def _fold_restore_fields(result: dict, restore_result: dict) -> None:
         if source in breakdown:
             result[target] = breakdown[source]
     for key in ("phase_sum_s", "phase_coverage", "goodput_fraction",
-                "goodput_buckets"):
+                "goodput_buckets", "restore_source"):
         if key in restore_result:
             result[key] = restore_result[key]
 

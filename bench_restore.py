@@ -69,11 +69,24 @@ def _read_events(events_file: str) -> list:
 
 
 def worker_main(ckpt_dir: str, events_file: str, total_steps: int,
-                at_scale: bool = False) -> int:
-    from dlrover_tpu.agent.elastic_agent import init_distributed
+                at_scale: bool = False, solo_replica: bool = False) -> int:
+    from dlrover_tpu.agent.elastic_agent import (
+        apply_jax_platform_env,
+        init_distributed,
+    )
 
-    _emit(events_file, {"event": "worker_start", "pid": os.getpid()})
-    init_distributed()   # applies JAX_PLATFORMS + joins the process set
+    rank = int(os.environ.get("DLROVER_TPU_NODE_RANK", "0"))
+    _emit(events_file, {"event": "worker_start", "pid": os.getpid(),
+                        "rank": rank})
+    if solo_replica:
+        # --nodes N on the CPU backend: each worker is an independent
+        # full DP replica (per-rank checkpoint dir, no cross-process
+        # collectives — jax has no multi-process CPU SPMD). The control
+        # plane, donor protocol and restore-plan delivery are exactly
+        # the replicated multi-host configuration the peer path serves.
+        apply_jax_platform_env()
+    else:
+        init_distributed()   # applies JAX_PLATFORMS + joins the process set
 
     import jax
     import jax.numpy as jnp
@@ -132,8 +145,28 @@ def worker_main(ckpt_dir: str, events_file: str, total_steps: int,
     )
     loop.install_signal_handler()
     state, start = loop.restore_or_init(jax.random.PRNGKey(0))
-    _emit(events_file, {"event": "restored", "step": start,
-                        "timings": loop.last_restore_timings})
+    restored_event = {"event": "restored", "step": start, "rank": rank,
+                      "timings": loop.last_restore_timings,
+                      "restore_source": loop.last_restore_source}
+    if os.environ.get("BENCH_RESTORE_STATE_CRC") == "1" and start > 0:
+        # bitwise-identity evidence for the acceptance test: a CRC over
+        # every restored leaf (host copies — tiny models only; the
+        # at-scale bench must not pay a 5 GB device_get for it)
+        import zlib
+
+        from dlrover_tpu.checkpoint.peer_restore import (
+            host_copy,
+            shard_items,
+        )
+
+        crc = 0
+        for _, leaf in shard_items(state):
+            arr = host_copy(leaf)
+            if arr is not None:
+                crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(),
+                                 crc)
+        restored_event["state_crc"] = crc & 0xFFFFFFFF
+    _emit(events_file, restored_event)
 
     restored_start = start
     if start > 0:
@@ -152,7 +185,7 @@ def worker_main(ckpt_dir: str, events_file: str, total_steps: int,
         t3 = time.perf_counter()
         start += 1
         _emit(events_file, {
-            "event": "step", "step": start,
+            "event": "step", "step": start, "rank": rank,
             "restored_from": restored_start,
             "first_step_detail": {
                 "shard_batch_s": round(t1 - t0, 2),
@@ -171,7 +204,7 @@ def worker_main(ckpt_dir: str, events_file: str, total_steps: int,
                                dtype=np.int32)
         state, _ = loop.run(state, [(tokens, targets)], start_step=step)
         step += 1
-        _emit(events_file, {"event": "step", "step": step,
+        _emit(events_file, {"event": "step", "step": step, "rank": rank,
                             "restored_from": restored_start})
         if loop._stop_requested.is_set():
             break
@@ -184,7 +217,16 @@ def worker_main(ckpt_dir: str, events_file: str, total_steps: int,
 # ---------------------------------------------------------------------------
 
 
-def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
+def run_bench(timeout_s: float = 480.0, at_scale: bool = False,
+              nodes: int = 1) -> dict:
+    """nodes > 1 clocks the TRUE replacement-host story: N agents form
+    one world, rank 0's worker is SIGKILLed AND its host-side peer cache
+    wiped (a replacement host starts cold), so its shards must arrive
+    over the donor protocol from the survivors — `restore_source: peer`
+    with remote donors. nodes == 1 keeps the cache (a worker crash on a
+    surviving host), so the peer path serves from local host RAM —
+    that is what turns the 105 s at-scale Orbax round-trip into
+    seconds."""
     from dlrover_tpu.agent.elastic_agent import ElasticAgent, WorkerSpec
     from dlrover_tpu.agent.master_client import MasterClient
     from dlrover_tpu.master.job_master import JobMaster
@@ -193,16 +235,35 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
     ckpt_dir = os.path.join(workdir, "ckpt")
     events_file = os.path.join(workdir, "events.jsonl")
 
-    master = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    master = JobMaster(min_nodes=nodes, max_nodes=nodes,
+                       host="127.0.0.1")
     master.prepare()
-    client = MasterClient(master.addr, node_id=0, node_rank=0)
-    entrypoint = [
-        sys.executable, os.path.abspath(__file__), "--worker",
-        "--ckpt-dir", ckpt_dir, "--events-file", events_file,
-    ]
-    if at_scale:
-        entrypoint.append("--at-scale")
+    multi = nodes > 1
+    # multi-node: per-rank checkpoint namespaces (each rank is a full DP
+    # replica saving its own copy; the kill wipes rank 0's peer cache so
+    # its shards must come over the donor protocol). rank 0's dir is the
+    # one the Orbax path would have used — the clocked comparison.
+    ckpt0 = os.path.join(ckpt_dir, "rank0") if multi else ckpt_dir
+
+    def _entrypoint(rank: int):
+        ep = [
+            sys.executable, os.path.abspath(__file__), "--worker",
+            "--ckpt-dir",
+            os.path.join(ckpt_dir, f"rank{rank}") if multi else ckpt_dir,
+            "--events-file", events_file,
+        ]
+        if at_scale:
+            ep.append("--at-scale")
+        if multi:
+            ep.append("--solo-replica")
+        return ep
+
     worker_env = {"JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0"}
+    if multi and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # one virtual device per replica: an inherited
+        # xla_force_host_platform_device_count (the test harness exports
+        # 8) would multiply into a dp size the toy batch cannot divide
+        worker_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     if at_scale:
         # Both incarnations share an on-disk compile cache: a restarted
         # process on the same host legitimately reuses it, and without
@@ -225,19 +286,26 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
         # run explicitly reverted to the exact-dtype baseline with =0
         worker_env["DLROVER_TPU_CKPT_QUANT_BITS"] = os.environ.get(
             "BENCH_RESTORE_QUANT_BITS", "8")
-    spec = WorkerSpec(
-        entrypoint=entrypoint,
-        devices_per_node=1,
-        max_restarts=3,
-        monitor_interval_s=0.2,
-        enable_monitors=False,
-        env=worker_env,
-    )
-    agent = ElasticAgent(client, spec)
-    agent_result: dict = {}
-    agent_thread = threading.Thread(
-        target=lambda: agent_result.update(code=agent.run()), daemon=True)
-    agent_thread.start()
+    clients, agents, threads = [], [], []
+    for rank in range(nodes):
+        client = MasterClient(master.addr, node_id=rank, node_rank=rank)
+        spec = WorkerSpec(
+            entrypoint=_entrypoint(rank),
+            devices_per_node=1,
+            max_restarts=3,
+            monitor_interval_s=0.2,
+            enable_monitors=False,
+            env=worker_env,
+        )
+        agent = ElasticAgent(client, spec)
+        clients.append(client)
+        agents.append(agent)
+        thread = threading.Thread(target=agent.run, daemon=True)
+        thread.start()
+        threads.append(thread)
+        if nodes > 1:
+            time.sleep(0.2)   # stagger so all land in one round
+    agent = agents[0]          # the victim's agent
 
     deadline = time.time() + timeout_s
 
@@ -252,11 +320,14 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
 
     def _committed_step() -> int:
         try:
-            steps = [int(name) for name in os.listdir(ckpt_dir)
+            steps = [int(name) for name in os.listdir(ckpt0)
                      if name.isdigit()]
             return max(steps) if steps else 0
         except OSError:
             return 0
+
+    def _rank0(event: dict) -> bool:
+        return int(event.get("rank", 0)) == 0
 
     try:
         # Phase 1: train past a committed checkpoint (the step event
@@ -265,13 +336,21 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
         _wait_for(
             lambda evs: next(
                 (e for e in evs
-                 if e["event"] == "step" and e["step"] >= KILL_AFTER_STEP
+                 if e["event"] == "step" and _rank0(e)
+                 and e["step"] >= KILL_AFTER_STEP
                  and _committed_step() >= 2),
                 None),
             f"step {KILL_AFTER_STEP} + committed checkpoint",
         )
         victim_pid = agent._proc.pid
         os.kill(victim_pid, signal.SIGKILL)
+        if nodes > 1:
+            # replacement-host simulation: the staged host cache died
+            # with the host, so rank 0's shards MUST come from the
+            # surviving donors over the wire
+            import shutil
+
+            shutil.rmtree(agent.peer_cache_dir, ignore_errors=True)
         t_kill = time.time()
 
         # Phase 2: agent detects the death, re-rendezvouses, respawns; the
@@ -279,7 +358,8 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
         first = _wait_for(
             lambda evs: next(
                 (e for e in evs
-                 if e["event"] == "step" and e.get("restored_from", 0) > 0
+                 if e["event"] == "step" and _rank0(e)
+                 and e.get("restored_from", 0) > 0
                  and e["t"] > t_kill),
                 None),
             "first step after restore",
@@ -287,25 +367,41 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
         events = _read_events(events_file)
         restored = next(
             e for e in events
-            if e["event"] == "restored" and e["t"] > t_kill)
+            if e["event"] == "restored" and _rank0(e)
+            and e["t"] > t_kill)
         elapsed = first["t"] - t_kill
         ckpt_bytes = 0
-        step_dir = os.path.join(ckpt_dir, str(restored["step"]))
-        for root, _, files in os.walk(step_dir):
-            ckpt_bytes += sum(
-                os.path.getsize(os.path.join(root, f)) for f in files)
+        # in multi mode rank 0 may have restored a step only the donor
+        # committed (the survivor trained past the victim's last save):
+        # size the restored step from whichever replica holds it
+        candidates = ([ckpt0] + [os.path.join(ckpt_dir, f"rank{r}")
+                                 for r in range(1, nodes)]
+                      if multi else [ckpt_dir])
+        for base in candidates:
+            step_dir = os.path.join(base, str(restored["step"]))
+            if os.path.isdir(step_dir):
+                for root, _, files in os.walk(step_dir):
+                    ckpt_bytes += sum(
+                        os.path.getsize(os.path.join(root, f))
+                        for f in files)
+                break
         # per-phase breakdown of the kill -> first-step window: detect/
         # respawn (kill -> worker_start), jax + loop build (worker_start
         # -> restore phases, from the worker's own timings), first step
         breakdown = dict(restored.get("timings") or {})
         respawn = next(
             (e for e in events
-             if e["event"] == "worker_start" and e["t"] > t_kill), None)
+             if e["event"] == "worker_start" and _rank0(e)
+             and e["t"] > t_kill), None)
         # the top-level phases that partition kill -> first step
         # exclusively (the restore_* sub-phases nest inside
-        # orbax_read_s and must NOT be double-summed)
+        # orbax_read_s, and peer_bytes/bandwidth are not durations).
+        # peer_plan_s + peer_transfer_s are the peer path's read; on the
+        # mixed path orbax_read_s additionally covers the shard-wise
+        # storage fallback — the phases stay disjoint either way.
         exclusive = ("detect_respawn_s", "loop_build_s",
-                     "abstract_state_s", "orbax_read_s",
+                     "abstract_state_s", "peer_plan_s",
+                     "peer_transfer_s", "orbax_read_s",
                      "device_ready_s", "post_sync_s",
                      "compile_wait_after_read_s", "first_step_s")
         if respawn is not None:
@@ -313,7 +409,8 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
                 respawn["t"] - t_kill, 2)
             measured = sum(
                 v for k, v in breakdown.items()
-                if k in ("abstract_state_s", "orbax_read_s",
+                if k in ("abstract_state_s", "peer_plan_s",
+                         "peer_transfer_s", "orbax_read_s",
                          "device_ready_s", "post_sync_s",
                          "compile_wait_after_read_s"))
             breakdown["loop_build_s"] = round(
@@ -328,11 +425,20 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
             "restored_step": restored["step"],
             "first_step_after_restore": first["step"],
             "checkpoint_gb": round(ckpt_bytes / (1 << 30), 2),
+            # where the replacement's state came from: "peer" (surviving
+            # hosts' staged memory), "mixed" (peer + shard-wise Orbax),
+            # "orbax" (full storage round-trip)
+            "restore_source": restored.get("restore_source", "orbax"),
+            "nodes": nodes,
             "breakdown": breakdown,
             "phase_sum_s": round(phase_sum, 2),
             "phase_coverage": round(phase_sum / elapsed, 3)
             if elapsed > 0 else 0.0,
         }
+        if "state_crc" in restored:
+            result["state_crc"] = restored["state_crc"]
+        result["workdir"] = workdir
+        result["ckpt_dir"] = ckpt0
         # the master's goodput ledger saw the whole episode through the
         # worker's step reports + telemetry spans: its productive
         # fraction + bucket split ride into the bench JSON so BENCH_r06+
@@ -343,8 +449,10 @@ def run_bench(timeout_s: float = 480.0, at_scale: bool = False) -> dict:
             k: v for k, v in snap.get("buckets", {}).items() if v > 0.0}
         return result
     finally:
-        agent.shutdown()
-        client.close()
+        for a in agents:
+            a.shutdown()
+        for c in clients:
+            c.close()
         master.stop()
 
 
@@ -358,11 +466,21 @@ def main() -> int:
     parser.add_argument("--at-scale", action="store_true",
                         help="bench-headline 1.47B model: clock a "
                              "multi-GB restore (VERDICT r3 item 1)")
+    parser.add_argument("--nodes", type=int, default=1,
+                        help="agents in the world; > 1 wipes the "
+                             "victim's host cache so its shards arrive "
+                             "over the donor protocol (replacement-host "
+                             "simulation)")
+    parser.add_argument("--solo-replica", action="store_true",
+                        help="worker mode: independent full DP replica "
+                             "(no jax.distributed; per-rank checkpoint)")
     args = parser.parse_args()
     if args.worker:
         return worker_main(args.ckpt_dir, args.events_file,
-                           args.total_steps, at_scale=args.at_scale)
-    result = run_bench(timeout_s=args.timeout, at_scale=args.at_scale)
+                           args.total_steps, at_scale=args.at_scale,
+                           solo_replica=args.solo_replica)
+    result = run_bench(timeout_s=args.timeout, at_scale=args.at_scale,
+                       nodes=args.nodes)
     seconds = result["elastic_restore_seconds"]
     metric = ("elastic_restore_seconds_at_scale" if args.at_scale
               else "elastic_restore_seconds")
@@ -373,6 +491,7 @@ def main() -> int:
                  f"restore step {result['restored_step']} "
                  f"[{result['checkpoint_gb']} GB] -> first step; 1 host)"),
         "vs_baseline": round(30.0 / max(seconds, 1e-9), 2),
+        "restore_source": result.get("restore_source", "orbax"),
         "breakdown": result.get("breakdown", {}),
         "checkpoint_gb": result["checkpoint_gb"],
         "phase_sum_s": result.get("phase_sum_s", 0.0),

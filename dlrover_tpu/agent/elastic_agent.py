@@ -244,6 +244,20 @@ class ElasticAgent:
         self._preempt_notice: Optional[PreemptionNotice] = None
         self._preempt_event = threading.Event()
         self._preempt_watcher: Optional[PreemptionWatcher] = None
+        # peer-to-peer restore plumbing (checkpoint/peer_restore.py):
+        # the worker stages its state here at checkpoint boundaries; the
+        # donor server (started in run(), owned by THIS process so it
+        # survives worker restarts) serves it to replacement ranks, and
+        # the join-result restore plan lands in the plan file for the
+        # worker
+        self.peer_cache_dir = os.path.join(self._workdir, "peer_cache")
+        self.restore_plan_file = os.path.join(self._workdir,
+                                              "restore_plan.json")
+        self._peer_donor = None
+        # (ino, mtime_ns, size) of the manifest at the last report —
+        # the same stat-key dedup contract as the drain channel, so the
+        # monitor tick never re-parses an unchanged manifest
+        self._peer_reported_statkey: Optional[Tuple] = None
         # relaunch pacing: backoff between respawns, quarantine on flap
         self._governor = RelaunchGovernor()
         self._spawn_ts = time.monotonic()
@@ -272,8 +286,13 @@ class ElasticAgent:
         with obs.span("rendezvous",
                       {"rdzv": self._rdzv_name,
                        "rank": self._client.node_rank}) as rdzv_span:
+            # advertise this host's staged state BEFORE joining: a
+            # replacement rank's plan (computed at its own join) must be
+            # able to name this survivor as a donor
+            self._report_peer_store(force=True)
             joined_round = self._client.join_rendezvous(
                 spec.devices_per_node, self._rdzv_name)
+            self._publish_restore_plan()
             deadline = time.time() + spec.rdzv_timeout_s
             while time.time() < deadline:
                 rdzv_round, _, world = self._client.get_comm_world(
@@ -328,6 +347,8 @@ class ElasticAgent:
             NodeEnv.TIMELINE_FILE: self.timeline_file,
             NodeEnv.PROFILE_REQUEST_FILE: self.profile_request_file,
             NodeEnv.DRAIN_REQUEST_FILE: self.drain_request_file,
+            NodeEnv.PEER_CACHE_DIR: self.peer_cache_dir,
+            NodeEnv.RESTORE_PLAN_FILE: self.restore_plan_file,
             # the worker sees the same notice path the agent polls, so
             # the chaos `preempt` fault (running in the worker's step
             # loop) can deliver a notice to THIS agent deterministically
@@ -435,6 +456,7 @@ class ElasticAgent:
         # the drain notice (and nobody re-raises the default kill: the
         # notice is the graceful alternative to dying now)
         self._start_preemption_watcher()
+        self._start_peer_donor()
         if threading.current_thread() is threading.main_thread():
             # postmortem timeline even when the platform SIGTERMs the
             # agent itself (signal API is main-thread-only)
@@ -456,6 +478,7 @@ class ElasticAgent:
             self._stop_monitors()
             if self._preempt_watcher is not None:
                 self._preempt_watcher.stop()
+            self._stop_peer_donor()
             self._flush_telemetry()
             obs.remove_span_sink(self._span_exporter)
             recorder.dump(reason="agent-exit")
@@ -529,6 +552,9 @@ class ElasticAgent:
                     self._handle_master_loss()
                 continue
             self._poll_diagnosis_actions()
+            # keep the master's donor registry fresh: the worker staged
+            # a newer step since the last report (cheap manifest stat)
+            self._report_peer_store()
             if waiting > 0:
                 logger.info(
                     "%d node(s) waiting: restarting worker to re-form the "
@@ -650,6 +676,75 @@ class ElasticAgent:
         )
         self._restart_worker_resilient(count_against_budget=counts)
         return None
+
+    # -- peer-to-peer restore ----------------------------------------------
+    def _start_peer_donor(self) -> None:
+        """Serve this host's staged state to replacement ranks. Owned by
+        the agent — it must survive the worker restarts every membership
+        change forces. Best-effort: with no donor the fleet degrades to
+        the Orbax restore path, never to a broken agent."""
+        from dlrover_tpu.common.config import Context
+
+        if not Context.singleton().peer_restore_enabled:
+            return
+        from dlrover_tpu.checkpoint.peer_restore import PeerDonorServer
+
+        try:
+            self._peer_donor = PeerDonorServer(self.peer_cache_dir)
+            self._peer_donor.start()
+        except Exception:  # noqa: BLE001 — port/bind failures vary
+            logger.warning("peer donor server failed to start; this "
+                           "host will not donate state", exc_info=True)
+            self._peer_donor = None
+
+    def _stop_peer_donor(self) -> None:
+        if self._peer_donor is not None:
+            self._peer_donor.stop()
+            self._peer_donor = None
+
+    def _report_peer_store(self, force: bool = False) -> None:
+        """Advertise the staged manifest (step + shard keys) to the
+        master's donor registry; withdrawn when nothing is staged. Only
+        a CHANGED manifest pays for the parse + RPC unless forced (the
+        monitor tick's check is one os.stat)."""
+        if self._peer_donor is None:
+            return
+        from dlrover_tpu.checkpoint.peer_restore import (
+            MANIFEST,
+            manifest_summary,
+        )
+
+        try:
+            st = os.stat(os.path.join(self.peer_cache_dir, MANIFEST))
+            statkey: Optional[Tuple] = (st.st_ino, st.st_mtime_ns,
+                                        st.st_size)
+        except OSError:
+            statkey = None
+        if not force and statkey == self._peer_reported_statkey:
+            return
+        step, keys, total_bytes = manifest_summary(self.peer_cache_dir)
+        try:
+            self._client.report_peer_store(
+                self._peer_donor.addr, step, keys,
+                total_bytes=total_bytes, rdzv_name=self._rdzv_name)
+            self._peer_reported_statkey = statkey
+        except Exception:  # noqa: BLE001 — registry refresh is
+            # best-effort; the next tick (or the pre-join force) retries
+            logger.warning("could not report peer store to the master")
+
+    def _publish_restore_plan(self) -> None:
+        """The restore plan the join result carried → the plan file the
+        spawned worker reads (workers with a master client re-fetch a
+        fresh plan via RPC; this copy serves the rest and records the
+        plan at the re-rendezvous cut)."""
+        payload = self._client.last_restore_plan_json or "{}"
+        tmp = f"{self.restore_plan_file}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self.restore_plan_file)
+        except OSError:
+            logger.warning("could not publish the restore plan file")
 
     # -- preemption drain --------------------------------------------------
     def _start_preemption_watcher(self) -> None:
@@ -955,6 +1050,7 @@ class ElasticAgent:
         if self._preempt_watcher is not None:
             self._preempt_watcher.stop()
         self._stop_worker()
+        self._stop_peer_donor()
         obs.remove_span_sink(self._span_exporter)
 
 

@@ -84,6 +84,9 @@ class MasterClient:
         # presented on reconnect so a restarted master can tell this
         # re-registration from a brand-new joiner
         self.master_generation = 0
+        # the peer-restore plan the last join result carried ("" = none):
+        # the agent publishes it to the worker via the plan file
+        self.last_restore_plan_json = ""
         # owned by the CLIENT, not the stub: a seeded chaos injector
         # must keep its RNG sequence across reconnect()s, or a seed
         # whose first draw fires would deterministically kill the first
@@ -213,6 +216,8 @@ class MasterClient:
         ), msg.JoinRendezvousResult)
         if result.generation:
             self.master_generation = result.generation
+        self.last_restore_plan_json = getattr(result,
+                                              "restore_plan_json", "")
         return result.round
 
     def reconnect_report(self, local_world_size: int = 1,
@@ -264,6 +269,47 @@ class MasterClient:
             msg.WaitingNodeNum,
         )
         return result.waiting_num
+
+    # -- peer-to-peer restore ---------------------------------------------
+    @retry_rpc(retries=3)
+    def report_peer_store(self, addr: str, step: int, keys,
+                          total_bytes: int = 0,
+                          rdzv_name: str = RendezvousName.TRAINING
+                          ) -> bool:
+        """Advertise (step >= 0) or withdraw (step < 0) this host's
+        staged peer-state cache with the master's donor registry."""
+        return self._report(msg.PeerStoreReport(
+            node_id=self.node_id, node_rank=self.node_rank, addr=addr,
+            step=step, rdzv_name=rdzv_name, keys=list(keys),
+            total_bytes=total_bytes,
+        )).success
+
+    @retry_rpc(retries=3)
+    def get_restore_plan(self, rdzv_name: str = RendezvousName.TRAINING
+                         ) -> dict:
+        """A fresh peer-restore plan for this rank ({} = no donors)."""
+        import json
+
+        result = self._get_typed(msg.RestorePlanRequest(
+            node_id=self.node_id, node_rank=self.node_rank,
+            rdzv_name=rdzv_name), msg.RestorePlan)
+        if not result.found or not result.plan_json:
+            return {}
+        try:
+            plan = json.loads(result.plan_json)
+        except json.JSONDecodeError:
+            return {}
+        return plan if isinstance(plan, dict) else {}
+
+    @retry_rpc(retries=3)
+    def get_restore_epoch(self, rdzv_name: str = RendezvousName.TRAINING
+                          ) -> int:
+        """The current world epoch — the staleness guard's commit-time
+        check against the epoch a restore plan was computed at."""
+        return self._get_typed(msg.RestorePlanRequest(
+            node_id=self.node_id, node_rank=self.node_rank,
+            rdzv_name=rdzv_name, epoch_only=True),
+            msg.RestorePlan).epoch
 
     def report_network_status(self, normal: bool, elapsed: float) -> bool:
         return self._report(msg.NetworkStatusReport(

@@ -1,8 +1,14 @@
-"""Flash checkpoint: async sharded save/restore with reshard-on-restore."""
+"""Flash checkpoint: async sharded save/restore with reshard-on-restore,
+plus the peer-to-peer restore path (surviving hosts donate state)."""
 
 from dlrover_tpu.checkpoint.flash_checkpoint import (  # noqa: F401
     FlashCheckpointer,
     abstract_state_for,
+)
+from dlrover_tpu.checkpoint.peer_restore import (  # noqa: F401
+    PeerDonorServer,
+    PeerRestorer,
+    PeerStateStore,
 )
 from dlrover_tpu.checkpoint.quantized import (  # noqa: F401
     abstract_encoded,

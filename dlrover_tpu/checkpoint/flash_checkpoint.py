@@ -281,7 +281,7 @@ class FlashCheckpointer:
         (ROADMAP item 1) starts from."""
         import time as _time
 
-        self.last_restore_phases = {}
+        self._begin_restore()
         t0 = _time.monotonic()
         with obs.span("restore_step_discovery"):
             steps = sorted(self._manager.all_steps() or (), reverse=True)
@@ -344,17 +344,22 @@ class FlashCheckpointer:
         if read_s > 0 and total_bytes > 0:
             phases["read_bandwidth_mbps"] = round(
                 total_bytes / (1 << 20) / read_s, 2)
+        # source-labeled: the peer-restore path publishes the same
+        # gauges as source="peer" — an unlabeled series would let one
+        # path silently overwrite the other's last reading
         registry = obs.get_registry()
         registry.gauge(
             "dlrover_tpu_checkpoint_restore_bytes",
-            "Bytes read from storage by the last checkpoint restore",
-        ).set(float(total_bytes))
+            "Bytes read by the last checkpoint restore",
+            labelnames=("source",),
+        ).labels(source="orbax").set(float(total_bytes))
         if phases.get("read_bandwidth_mbps"):
             registry.gauge(
                 "dlrover_tpu_checkpoint_restore_bandwidth_mbps",
-                "Effective storage bandwidth of the last restore's "
+                "Effective bandwidth of the last restore's "
                 "tensor-read phase",
-            ).set(phases["read_bandwidth_mbps"])
+                labelnames=("source",),
+            ).labels(source="orbax").set(phases["read_bandwidth_mbps"])
 
     def _remove_failed_steps(self, steps) -> None:
         """Drop the corrupt newer steps a fallback skipped: the resumed
@@ -374,6 +379,38 @@ class FlashCheckpointer:
             logger.warning(
                 "checkpoint: removed unrestorable step %d (resumed "
                 "training will rewrite it)", step)
+
+    def restore_data_state(self, step: int) -> Optional[Dict[str, Any]]:
+        """Just the tiny JSON data item of one committed step (sampler
+        position + master shard checkpoint), markers stripped — the
+        peer-restore path's fallback when no donor manifest carries the
+        data position. None when the step/item is unreadable."""
+        try:
+            data = self._manager.restore(
+                step, args=ocp.args.Composite(**{
+                    _DATA_ITEM: ocp.args.JsonRestore()}),
+            )[_DATA_ITEM] or {}
+        except Exception:  # noqa: BLE001 — Orbax raise varies
+            return None
+        data = dict(data)
+        data.pop(_QUANT_KEY, None)
+        data.pop(_QUANT_LAYOUT_KEY, None)
+        return data
+
+    def _begin_restore(self) -> None:
+        """Sole writer of ``last_restore_phases`` (single-threaded by
+        contract: only the restoring thread, and read after return)."""
+        self.last_restore_phases = {}
+
+    def restore_step(self, step: int, abstract_state: Any
+                     ) -> Tuple[Any, Dict[str, Any], int]:
+        """Restore ONE specific committed step — no newest-first
+        fallback walk. The peer-restore mixed path uses it to read only
+        the shards no surviving replica holds, at exactly the step the
+        peers staged (mixing steps would assemble a state that never
+        existed)."""
+        self._begin_restore()
+        return self._restore_at(step, abstract_state)
 
     def _restore_at(self, step: int, abstract_state: Any
                     ) -> Tuple[Any, Dict[str, Any], int]:

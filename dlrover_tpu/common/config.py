@@ -122,6 +122,16 @@ class Context:
         self.emergency_ckpt_min_window_s: float = (
             DefaultValues.EMERGENCY_CKPT_MIN_WINDOW_S
         )
+        # peer-to-peer elastic restore (checkpoint/peer_restore.py):
+        # replacement ranks restore from surviving hosts' staged state,
+        # falling back to Orbax shard-wise when no replica survived
+        self.peer_restore_enabled: bool = (
+            DefaultValues.PEER_RESTORE_ENABLED
+        )
+        self.peer_restore_timeout_s: float = (
+            DefaultValues.PEER_RESTORE_TIMEOUT_S
+        )
+        self.peer_donor_port: int = DefaultValues.PEER_DONOR_PORT
         # step-hang watchdog (trainer/watchdog.py); 0 = disabled
         self.hang_watchdog_s: float = DefaultValues.HANG_WATCHDOG_S
         # per-rank relaunch backoff + quarantine (agent/elastic_agent.py)

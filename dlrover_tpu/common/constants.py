@@ -166,6 +166,13 @@ class NodeEnv:
     # it on a preemption notice — save+exit — or when executing a
     # master `checkpoint:{rank}` action — save+continue)
     DRAIN_REQUEST_FILE = "DLROVER_TPU_DRAIN_REQUEST"
+    # host-RAM peer-state cache (checkpoint/peer_restore.py): the worker
+    # stages its live state here at checkpoint boundaries; the agent's
+    # donor server serves it to replacement ranks
+    PEER_CACHE_DIR = "DLROVER_TPU_PEER_CACHE_DIR"
+    # restore plan the agent received in its join result (JSON file);
+    # workers with a master client re-fetch a fresh plan via RPC instead
+    RESTORE_PLAN_FILE = "DLROVER_TPU_RESTORE_PLAN"
     # platform/chaos → agent: a preemption-notice file the agent's
     # PreemptionWatcher polls ({"deadline": ts} or {"grace_s": n})
     PREEMPTION_NOTICE_FILE = "DLROVER_TPU_PREEMPTION_NOTICE"
@@ -305,6 +312,17 @@ class DefaultValues:
     # below this floor (a save that cannot commit only produces a torn
     # step the restore fallback then has to walk past)
     EMERGENCY_CKPT_MIN_WINDOW_S = 2.0
+    # -- peer-to-peer elastic restore (checkpoint/peer_restore.py) ------
+    # serve a replacement rank's shards from surviving hosts' staged
+    # state instead of Orbax storage (restore time independent of model
+    # size); False reverts every restore to the storage path
+    PEER_RESTORE_ENABLED = True
+    # wall-clock budget for the peer shard transfer: past it the restore
+    # aborts shard-wise to the Orbax fallback instead of hanging
+    PEER_RESTORE_TIMEOUT_S = 120.0
+    # donor server port (0 = ephemeral; the advertised addr rides the
+    # PeerStoreReport RPC either way)
+    PEER_DONOR_PORT = 0
     # -- step-hang watchdog (trainer/watchdog.py) -----------------------
     # no step progress for this long → dump all-thread stacks + the
     # flight record and self-abort so the agent restarts the worker.

@@ -189,6 +189,13 @@ class JoinRendezvousResult(Message):
     # lineage): agents remember it and present it on reconnect so a
     # restarted master can tell re-registration from a new joiner.
     generation: int = 0
+    # Peer-to-peer restore plan for this rank (checkpoint/peer_restore.py):
+    # JSON {"epoch", "step", "entries": {shard_key: {"rank", "addr"}}}
+    # mapping each staged shard to a surviving donor. "" = no donors (or
+    # sender predates the field); the worker re-fetches via
+    # RestorePlanRequest anyway — this copy serves workers with no master
+    # client and records the plan at the re-rendezvous cut.
+    restore_plan_json: str = ""
 
 
 @dataclass
@@ -253,6 +260,44 @@ class CommWorld(Message):
     group: int = 0
     # node_rank → local_world_size
     world: Dict[int, int] = field(default_factory=dict)
+
+
+@dataclass
+class PeerStoreReport(Message):
+    """An agent advertising its host's staged peer-state cache
+    (checkpoint/peer_restore.py): which shards of which step its donor
+    server can serve to a replacement rank. step < 0 (or no keys) =
+    nothing staged — the master drops the registration."""
+
+    node_id: int = -1
+    node_rank: int = -1
+    addr: str = ""               # donor server "ip:port"
+    step: int = -1
+    rdzv_name: str = ""
+    keys: List[str] = field(default_factory=list)
+    total_bytes: int = 0
+
+
+@dataclass
+class RestorePlanRequest(Message):
+    """A restoring worker asking for a (fresh) peer-restore plan —
+    or, with epoch_only, just the current world epoch: the staleness
+    guard re-checks it immediately before committing a transfer."""
+
+    node_id: int = -1
+    node_rank: int = -1
+    rdzv_name: str = ""
+    epoch_only: bool = False
+
+
+@dataclass
+class RestorePlan(Message):
+    plan_json: str = ""          # JSON plan dict ("" with epoch_only)
+    # world epoch the plan was computed at (bumped on every membership
+    # loss): a plan whose epoch no longer matches must not commit
+    epoch: int = 0
+    step: int = -1
+    found: bool = False
 
 
 @dataclass

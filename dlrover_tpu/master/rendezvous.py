@@ -81,6 +81,16 @@ class RendezvousManager:
         # complete_drain (or a blown deadline) the world re-forms in
         # ONE round instead of waiting out the liveness timeout.
         self._draining: Dict[int, float] = {}
+        # peer-to-peer restore (checkpoint/peer_restore.py): rank ->
+        # {addr, step, keys, bytes, ts} of the staged state its agent's
+        # donor server can serve to a replacement rank
+        self._peer_stores: Dict[int, Dict] = {}
+        # bumped on EVERY membership loss (death, reap, drain
+        # completion): restore plans are stamped with it, and a plan
+        # whose epoch no longer matches must not commit — a second
+        # failure mid-transfer may have taken the donor (or made the
+        # planned world itself stale)
+        self._world_epoch = 0
 
     # -- membership (driven by the node manager / event callbacks) --------
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
@@ -172,6 +182,76 @@ class RendezvousManager:
         with self._lock:
             return dict(self._draining)
 
+    # -- peer-to-peer restore (checkpoint/peer_restore.py) -----------------
+    @property
+    def world_epoch(self) -> int:
+        with self._lock:
+            return self._world_epoch
+
+    def register_peer_store(self, node_rank: int, addr: str, step: int,
+                            keys, total_bytes: int = 0) -> None:
+        """An agent advertising (or withdrawing: step < 0 / no keys) the
+        staged state its donor server can serve."""
+        with self._lock:
+            if step < 0 or not keys:
+                if self._peer_stores.pop(node_rank, None) is not None:
+                    self._mutations += 1
+                return
+            self._peer_stores[node_rank] = {
+                "addr": addr, "step": int(step), "keys": list(keys),
+                "bytes": int(total_bytes), "ts": time.time(),
+            }
+            self._mutations += 1
+
+    @property
+    def peer_stores(self) -> Dict[int, Dict]:
+        with self._lock:
+            return {rank: dict(s) for rank, s in self._peer_stores.items()}
+
+    def compute_restore_plan(self, node_rank: int) -> Dict:
+        """For each staged shard a restoring rank may need, which
+        surviving donor serves it. Donors: alive, not draining, staged
+        at the newest common step (mixing steps would assemble a state
+        that never existed). The requester's own store wins for shards
+        it holds (a local read beats the network); the rest round-robin
+        across donors. Stamped with the world epoch — the staleness
+        guard. Pure dict work under the lock; JSON encoding is the
+        caller's business."""
+        with self._lock:
+            stores = {
+                rank: store
+                for rank, store in self._peer_stores.items()
+                if rank in self._alive_nodes
+                and rank not in self._draining
+            }
+            epoch = self._world_epoch
+            if not stores:
+                return {"epoch": epoch, "step": -1, "entries": {},
+                        "donors": {}}
+            step = max(store["step"] for store in stores.values())
+            at_step = {rank: store for rank, store in stores.items()
+                       if store["step"] == step}
+            holders: Dict[str, List[int]] = {}
+            for rank in sorted(at_step):
+                for key in at_step[rank]["keys"]:
+                    holders.setdefault(key, []).append(rank)
+            entries: Dict[str, Dict] = {}
+            spread = 0
+            for key in sorted(holders):
+                ranks = holders[key]
+                if node_rank in ranks:
+                    donor = node_rank
+                else:
+                    donor = ranks[spread % len(ranks)]
+                    spread += 1
+                entries[key] = {"rank": donor,
+                                "addr": at_step[donor]["addr"]}
+            return {
+                "epoch": epoch, "step": step, "entries": entries,
+                "donors": {rank: at_step[rank]["addr"]
+                           for rank in at_step},
+            }
+
     def reap_dead_nodes(self, timeout_s: float) -> None:
         """Declare ranks silent for > timeout_s dead (world invalidation
         via remove_alive_node). 0/negative disables. Runs on live agents'
@@ -211,10 +291,18 @@ class RendezvousManager:
         valid for them and must NOT be invalidated — only a death does."""
         invalidated_round = None
         with self._lock:
+            if (node_rank in self._alive_nodes
+                    or node_rank in self._latest_world):
+                # a real membership loss: any restore plan computed
+                # before this instant may name the departed rank as a
+                # donor — the epoch bump invalidates it at commit time
+                self._world_epoch += 1
             self._alive_nodes.discard(node_rank)
             self._waiting.pop(node_rank, None)
             self._pending_rejoin.discard(node_rank)
             self._draining.pop(node_rank, None)
+            # the host's staged state goes with the host
+            self._peer_stores.pop(node_rank, None)
             self._mutations += 1
             if not graceful and node_rank in self._latest_world:
                 # A member of the cut round died: any survivor handed this
@@ -426,6 +514,13 @@ class RendezvousManager:
                              for r, ip in self._node_ips.items()},
                 "draining": {str(r): deadline
                              for r, deadline in self._draining.items()},
+                "world_epoch": self._world_epoch,
+                "peer_stores": {
+                    str(r): {"addr": s["addr"], "step": s["step"],
+                             "keys": list(s["keys"]),
+                             "bytes": s.get("bytes", 0)}
+                    for r, s in self._peer_stores.items()
+                },
             }
             # subclass fields join the SAME cut: one lock acquisition,
             # never two cuts with a mutation in between
@@ -460,6 +555,20 @@ class RendezvousManager:
             self._draining = {int(r): float(d)
                               for r, d in state.get("draining",
                                                     {}).items()}
+            # a restored plan epoch keeps in-flight plans valid across a
+            # master failover — the membership they were computed from
+            # was restored with them; peer stores re-register within a
+            # monitor tick anyway, but restoring them means a restore
+            # landing mid-failover still gets a plan
+            self._world_epoch = int(state.get("world_epoch", 0))
+            self._peer_stores = {
+                int(r): {"addr": s.get("addr", ""),
+                         "step": int(s.get("step", -1)),
+                         "keys": list(s.get("keys", ())),
+                         "bytes": int(s.get("bytes", 0)),
+                         "ts": now}
+                for r, s in state.get("peer_stores", {}).items()
+            }
             # every restored member gets a fresh liveness clock: agents
             # re-register within their poll interval, the genuinely dead
             # age out through the normal reap path

@@ -44,6 +44,7 @@ _MUTATING_REPORTS = (
     msg.ShardCheckpoint,
     msg.ScaleRequest,
     msg.ModelInfo,
+    msg.PeerStoreReport,     # donor registry feeds restore plans
 )
 
 
@@ -178,6 +179,23 @@ class MasterServicer:
             return msg.GoodputReport(report_json=json.dumps(
                 self.goodput_ledger.snapshot(
                     window_s=request.window_s)))
+        if isinstance(request, msg.RestorePlanRequest):
+            import json
+
+            mgr = self.rdzv_managers.get(
+                request.rdzv_name or RendezvousName.TRAINING)
+            if mgr is None:
+                return msg.RestorePlan()
+            if request.epoch_only:
+                # the staleness guard's commit-time check: just the
+                # current world epoch, no plan computation
+                return msg.RestorePlan(epoch=mgr.world_epoch)
+            plan = mgr.compute_restore_plan(request.node_rank)
+            return msg.RestorePlan(
+                plan_json=json.dumps(plan),
+                epoch=int(plan.get("epoch", 0)),
+                step=int(plan.get("step", -1)),
+                found=bool(plan.get("entries")))
         if isinstance(request, msg.KVGetRequest):
             return msg.KeyValuePair(key=request.key,
                                     value=self.kv_store.get(request.key))
@@ -250,8 +268,21 @@ class MasterServicer:
                     request.node_rank, request.local_world_size,
                     request.node_ip)
             self._sink_state()
+            plan_json = ""
+            if request.rdzv_name == RendezvousName.TRAINING:
+                # the restore plan rides the join result: which
+                # surviving donor serves each staged shard this rank
+                # may need (checkpoint/peer_restore.py). Best-effort at
+                # this instant — late-registering donors are picked up
+                # by the worker's RestorePlanRequest re-fetch.
+                import json
+
+                plan = mgr.compute_restore_plan(request.node_rank)
+                if plan.get("entries"):
+                    plan_json = json.dumps(plan)
             return msg.JoinRendezvousResult(round=rdzv_round,
-                                            generation=self.generation)
+                                            generation=self.generation,
+                                            restore_plan_json=plan_json)
         elif isinstance(request, msg.ReconnectRequest):
             return self._handle_reconnect(request)
         elif isinstance(request, msg.DrainReport):
@@ -336,6 +367,13 @@ class MasterServicer:
                 elif request.exit_kind != NodeExitReason.DRAINED:
                     self.goodput_ledger.note_elasticity_event(
                         "worker_lost")
+        elif isinstance(request, msg.PeerStoreReport):
+            mgr = self.rdzv_managers.get(
+                request.rdzv_name or RendezvousName.TRAINING)
+            if mgr is not None:
+                mgr.register_peer_store(
+                    request.node_rank, request.addr, request.step,
+                    request.keys, request.total_bytes)
         elif isinstance(request, msg.NodeAddressReport):
             self.kv_store.set(f"node-addr/{request.node_rank}",
                               request.addr.encode())

@@ -14,6 +14,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.common.constants import MeshAxis
@@ -95,3 +96,36 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def unbox(tree: Any) -> Any:
     """Strip nn.Partitioned boxes (for code that wants raw arrays)."""
     return nn.unbox(tree)
+
+
+def sharded_from_host(host_tree: Any, abstract_tree: Any) -> Any:
+    """Host buffers → global arrays in the abstract tree's shardings.
+
+    The resharding primitive behind peer-to-peer restore (and the
+    starting point for online parallelism re-planning): each process
+    materializes only its addressable shards via
+    ``jax.make_array_from_callback``, so a full-replica host buffer
+    lands as a sharded/replicated device array without a second full
+    copy per device, on one host or many."""
+    def put(host_leaf, abstract_leaf):
+        sharding = getattr(abstract_leaf, "sharding", None)
+        if isinstance(host_leaf, jax.Array):
+            # already on device (e.g. the mixed-restore Orbax overlay):
+            # reshard in place — never a host round-trip
+            return (host_leaf if sharding is None
+                    else jax.device_put(host_leaf, sharding))
+        arr = np.asarray(host_leaf)
+        if sharding is None:
+            return jax.device_put(arr)
+        return jax.make_array_from_callback(
+            tuple(arr.shape), sharding, lambda idx: arr[idx])
+
+    return jax.tree.map(put, host_tree, abstract_tree)
+
+
+def reshard(tree: Any, shardings: Any) -> Any:
+    """Live device arrays → new shardings (a resize-time state
+    migration: the collective moves shards instead of a checkpoint
+    round-trip)."""
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree,
+                        shardings)

@@ -148,6 +148,24 @@ class ElasticTrainLoop:
         )
         self._stop_requested = threading.Event()
         self.last_restore_timings: Dict[str, float] = {}
+        # where the last restore's state came from: "peer" (surviving
+        # hosts' staged memory), "mixed" (peer + shard-wise Orbax),
+        # "orbax" (storage), "init" (fresh)
+        self.last_restore_source = ""
+        # peer-to-peer restore (checkpoint/peer_restore.py): the staging
+        # store mirrors the live state host-side at every checkpoint
+        # boundary; the restorer turns a master restore plan into a
+        # shard transfer from surviving donors
+        from dlrover_tpu.checkpoint.peer_restore import (
+            PeerRestorer,
+            PeerStateStore,
+        )
+
+        self._peer_store = (PeerStateStore.from_env()
+                            if self.checkpointer is not None else None)
+        self._peer_restorer = (
+            PeerRestorer.from_env(client=self.client)
+            if self.checkpointer is not None else None)
         self._chaos = None  # built lazily: env may be set post-init
         self._prev_sigterm = None
         # per-step phase attribution (data-wait / h2d / compute /
@@ -331,24 +349,55 @@ class ElasticTrainLoop:
                 compile_thread.start()
             if self.checkpointer is None:
                 state, step = self.trainer.init(rng), 0
+                self.last_restore_source = "init"
             else:
                 t0 = _time.monotonic()
                 abstract = self.trainer.abstract_state(rng)
                 timings["abstract_state_s"] = round(
                     _time.monotonic() - t0, 2)
-                t0 = _time.monotonic()
-                restored = self.checkpointer.restore(abstract)
-                timings["orbax_read_s"] = round(_time.monotonic() - t0, 2)
-                # the checkpointer's own per-phase decomposition (step
-                # discovery / metadata / tensor read / decode, bytes +
-                # bandwidth) nests under orbax_read_s
-                for key, value in getattr(self.checkpointer,
-                                          "last_restore_phases",
-                                          {}).items():
-                    timings[f"restore_{key}"] = value
+                source = "orbax"
+                restored = None
+                if self._peer_restorer is not None:
+                    # the peer branch: surviving hosts' staged state
+                    # instead of the storage round-trip, overlapped with
+                    # the same background compile as the Orbax read
+                    peer = None
+                    try:
+                        peer = self._peer_restorer.restore(
+                            abstract, self.checkpointer, timings)
+                    except Exception:  # noqa: BLE001 — peers are an
+                        # optimization; storage is the ground truth
+                        logger.warning("peer restore failed; falling "
+                                       "back to Orbax", exc_info=True)
+                    if peer is not None:
+                        p_state, p_data, p_step, source = peer
+                        restored = (p_state, p_data, p_step)
+                if restored is None:
+                    source = "orbax"
+                    t0 = _time.monotonic()
+                    restored = self.checkpointer.restore(abstract)
+                    timings["orbax_read_s"] = round(
+                        _time.monotonic() - t0, 2)
+                    # the checkpointer's own per-phase decomposition
+                    # (step discovery / metadata / tensor read / decode,
+                    # bytes + bandwidth) nests under orbax_read_s
+                    for key, value in getattr(self.checkpointer,
+                                              "last_restore_phases",
+                                              {}).items():
+                        timings[f"restore_{key}"] = value
                 if restored is None:
                     state, step = self.trainer.init(rng), 0
+                    self.last_restore_source = "init"
                 else:
+                    self.last_restore_source = source
+                    if source == "orbax":
+                        # peer/mixed count themselves (with the donor
+                        # table) inside the restorer
+                        obs.get_registry().counter(
+                            "dlrover_tpu_restore_source_total",
+                            "Elastic restores by state source",
+                            labelnames=("source",),
+                        ).labels(source="orbax").inc()
                     state, data_state, step = restored
                     # split the read from any deferred host->device
                     # transfer (remote-execution backends materialize
@@ -386,6 +435,7 @@ class ElasticTrainLoop:
                 timings.update(
                     getattr(self.trainer, "precompile_timings", {}))
             restore_span.set_attr("start_step", step)
+            restore_span.set_attr("source", self.last_restore_source)
             for key, value in timings.items():
                 restore_span.set_attr(key, value)
         if timings:
@@ -481,9 +531,19 @@ class ElasticTrainLoop:
             ckpt_s = 0.0
             if self.checkpointer is not None:
                 forced = self._stop_requested.is_set()
-                self.checkpointer.maybe_save(
-                    step, state, self._data_state(sampler), force=forced,
+                data_state = self._data_state(sampler)
+                saved = self.checkpointer.maybe_save(
+                    step, state, data_state, force=forced,
                 )
+                if saved:
+                    # mirror the saved cut into the host-RAM peer
+                    # cache: peer step N and Orbax step N are the same
+                    # cut, so a shard-wise restore across both sources
+                    # stays consistent (with a quantized checkpoint the
+                    # peer copy keeps live precision — strictly higher
+                    # fidelity than the storage path's dequantized
+                    # leaves)
+                    self._stage_peer(step, state, data_state)
                 ckpt_s = _time.monotonic() - t_compute_end
             if self._watchdog is not None:
                 self._watchdog.notify_step(step)
@@ -553,6 +613,7 @@ class ElasticTrainLoop:
             max(0.0, deadline - _time.time()) if deadline else -1.0,
             exit_worker, reason or "-")
         outcome = "no-checkpointer"
+        data_state = self._data_state(sampler)
         if self.checkpointer is not None:
             # the deadline is a hard bound only on the way OUT (this
             # VM dies then). A survivor's save-and-continue inherits
@@ -560,8 +621,15 @@ class ElasticTrainLoop:
             # worker is not dying, and skipping/aborting its save
             # because the peer's window is short defeats the fan-out
             outcome = self.checkpointer.save_emergency(
-                step, state, self._data_state(sampler),
+                step, state, data_state,
                 deadline=deadline if exit_worker else 0.0)
+            if outcome == "saved" and not exit_worker:
+                # a survivor's save-and-continue: mirror the cut into
+                # the peer cache too — this survivor is exactly who the
+                # departing rank's replacement will restore from. The
+                # exiting path skips it: this host's memory dies with
+                # the VM.
+                self._stage_peer(step, state, data_state)
         elif exit_worker:
             logger.error("drain with no checkpointer configured: "
                          "exiting WITHOUT saving (progress since the "
@@ -580,6 +648,28 @@ class ElasticTrainLoop:
         logger.info("drained at step %d (checkpoint: %s); exiting %d",
                     step, outcome, WorkerExit.DRAIN)
         raise DrainExit(reason)
+
+    # -- peer-state staging --------------------------------------------
+    def _stage_peer(self, step: int, state, data_state) -> None:
+        """Mirror the just-saved state into the host-RAM peer cache.
+        The step loop pays only the device→host copy (the arrays may be
+        donated away by the next step); file writes + CRCs run on the
+        store's background writer. Best-effort: the loop survives a
+        full cache disk."""
+        if self._peer_store is None:
+            return
+        import time as _time
+
+        t0 = _time.monotonic()
+        with obs.span("peer_stage", {"step": step}) as stage_span:
+            staged = self._peer_store.stage(step, state, data_state,
+                                            defer_write=True)
+            stage_span.set_attr("staged", staged)
+        obs.get_registry().gauge(
+            "dlrover_tpu_peer_stage_seconds",
+            "Step-loop wall-clock of the last peer-state staging "
+            "(host copy only; the write is deferred)").set(
+            round(_time.monotonic() - t0, 3))
 
     # -- progress reporting ------------------------------------------------
     def _report_progress(self, step: int) -> None:
@@ -656,6 +746,10 @@ class ElasticTrainLoop:
     def close(self) -> None:
         self._flush_telemetry()
         obs.remove_span_sink(self._span_exporter)
+        if self._peer_store is not None:
+            # a deferred stage write still in flight must land before
+            # the process goes away (the whole point of the mirror)
+            self._peer_store.flush()
         if self.checkpointer is not None:
             self.checkpointer.close()
         if self._prev_sigterm is not None:

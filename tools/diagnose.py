@@ -108,6 +108,44 @@ def render_lifecycle(payload: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# flight events describing an elastic restore's state sources
+# (checkpoint/peer_restore.py + elastic_loop)
+_RESTORE_EVENTS = (
+    "peer_restore", "peer_restore_fallback", "peer_restore_skipped",
+    "restore_plan_stale",
+)
+
+
+def render_restore(payload: Dict[str, Any]) -> str:
+    """Restore-source section of a flight dump: where each restore's
+    state came from (peer / mixed / orbax), the per-donor byte table,
+    and any fallback / staleness rejections — the one-glance answer to
+    "did the replacement restore from peers, and who served it?"."""
+    events = [record for record in payload.get("events", [])
+              if record.get("kind") == "event"
+              and record.get("name") in _RESTORE_EVENTS]
+    lines = [f"restore source events: {len(events)}"]
+    if not events:
+        return "\n".join(lines)
+    ordered = sorted(events, key=lambda e: e.get("ts", 0.0))
+    t0 = ordered[0].get("ts", 0.0)
+    for record in ordered:
+        attrs = dict(record.get("attrs", {}))
+        donors = attrs.pop("donors", None)
+        detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        lines.append("+{offset:8.1f}s  {name:<22} {detail}".format(
+            offset=record.get("ts", 0.0) - t0,
+            name=str(record.get("name", "?")),
+            detail=detail).rstrip())
+        if donors:
+            lines.append("{:>12}  {:<24} {:>14}".format(
+                "", "donor", "bytes"))
+            for donor, nbytes in sorted(donors.items()):
+                lines.append("{:>12}  {:<24} {:>14,}".format(
+                    "", str(donor), int(nbytes)))
+    return "\n".join(lines)
+
+
 def render_goodput(payload: Dict[str, Any]) -> str:
     """Goodput-ledger section of a flight dump: the bucket split plus
     the per-incarnation badput attribution (obs/goodput.py). Dumps
@@ -213,6 +251,7 @@ def main(argv=None) -> int:
         print(f"== {path}")
         print(render_reports(reports_from_flight(payload)))
         print(render_lifecycle(payload))
+        print(render_restore(payload))
         print(render_goodput(payload))
     for path in ns.timeline:
         payload = _load_json(path)
