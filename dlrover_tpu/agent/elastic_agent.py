@@ -326,8 +326,15 @@ class ElasticAgent:
         publishes the coordinator address via the master KV store."""
         ranks = sorted(world)
         process_id = ranks.index(self._client.node_rank)
+        slice_id = self._client.slice_id
+        # slice mode: each slice is its own jax world with its own
+        # per-slice round counter — the coordinator key must be scoped
+        # by slice or two slices cutting round N would collide
+        coord_key = (f"coord/{self._rdzv_name}/slice{slice_id}/"
+                     f"{rdzv_round}" if slice_id >= 0
+                     else f"coord/{self._rdzv_name}/{rdzv_round}")
         coord = publish_or_wait_coordinator(
-            self._client, f"coord/{self._rdzv_name}/{rdzv_round}",
+            self._client, coord_key,
             process_id, self._spec.rdzv_timeout_s,
         )
         env = dict(os.environ)
@@ -353,6 +360,9 @@ class ElasticAgent:
             # the chaos `preempt` fault (running in the worker's step
             # loop) can deliver a notice to THIS agent deterministically
             NodeEnv.PREEMPTION_NOTICE_FILE: self.preempt_notice_file,
+            # the worker's slice identity: gates the cross-slice
+            # gradient sync and slice-targeted chaos faults
+            NodeEnv.SLICE_ID: str(slice_id),
         })
         env.setdefault("JAX_COMPILATION_CACHE_DIR", self.compile_cache_dir)
         return env
@@ -871,6 +881,8 @@ class ElasticAgent:
             self._request_profile(action)
         elif kind == "checkpoint":
             self._request_checkpoint(action)
+        elif kind == "drain":
+            self._request_slice_drain(action)
         elif kind == "restart":
             logger.warning("diagnosis: restarting worker (%s)", reason)
             self._restart_worker_resilient(count_against_budget=False)
@@ -911,6 +923,29 @@ class ElasticAgent:
             "diagnosis: urgent checkpoint requested of the worker "
             "(#%d, deadline in %.0fs)", self._drain_seq,
             max(0.0, deadline - time.time()))
+
+    def _request_slice_drain(self, action: dict) -> None:
+        """A master ``drain:{rank}`` action (this rank's SLICE is
+        draining — some peer in it got the preemption notice): hand the
+        worker a save-and-EXIT request. The worker departs with the
+        clean-drain code, the run loop classifies it DRAINED and
+        concludes the drain with the master — the whole slice leaves as
+        one unit, no liveness-timeout stragglers."""
+        from dlrover_tpu.common.config import Context
+
+        self._drain_seq += 1
+        deadline = float(action.get("deadline", 0.0) or 0.0)
+        if deadline <= 0.0:
+            deadline = (time.time()
+                        + Context.singleton().preempt_default_grace_s)
+        write_drain_request(
+            self.drain_request_file, self._drain_seq, deadline,
+            reason=str(action.get("reason", "")), exit_worker=True)
+        logger.warning(
+            "slice drain requested of the worker (#%d, deadline in "
+            "%.0fs): %s", self._drain_seq,
+            max(0.0, deadline - time.time()),
+            str(action.get("reason", ""))[:256])
 
     # -- master failover ---------------------------------------------------
     def _handle_master_loss(self) -> None:

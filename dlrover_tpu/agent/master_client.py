@@ -71,11 +71,17 @@ class MasterClient:
     def __init__(self, master_addr: str, node_id: int = 0,
                  node_rank: Optional[int] = None,
                  timeout_s: Optional[float] = None,
-                 node_type: str = ""):
+                 node_type: str = "",
+                 slice_id: Optional[int] = None):
         self.master_addr = master_addr
         self.node_id = node_id
         self.node_type = node_type
         self.node_rank = node_rank if node_rank is not None else node_id
+        # ICI slice this node belongs to (multi-slice hierarchical DP);
+        # -1 = single-slice job. Explicit param wins over the env so
+        # in-process tests can run several slices in one process.
+        self.slice_id = (slice_id if slice_id is not None
+                         else int(os.getenv(NodeEnv.SLICE_ID, "-1")))
         # per-call deadline; wait_for_ready means an unreachable master
         # surfaces as DEADLINE_EXCEEDED after exactly this long
         self._timeout_s = (timeout_s if timeout_s is not None
@@ -213,6 +219,7 @@ class MasterClient:
             rdzv_name=rdzv_name,
             node_ip=local_ip(),
             trace=current_context() or {},
+            slice_id=self.slice_id,
         ), msg.JoinRendezvousResult)
         if result.generation:
             self.master_generation = result.generation
@@ -235,6 +242,7 @@ class MasterClient:
             rdzv_name=rdzv_name,
             generation=self.master_generation,
             rdzv_round=rdzv_round,
+            slice_id=self.slice_id,
         ), msg.ReconnectResult)
         if result.generation:
             self.master_generation = result.generation
@@ -281,8 +289,28 @@ class MasterClient:
         return self._report(msg.PeerStoreReport(
             node_id=self.node_id, node_rank=self.node_rank, addr=addr,
             step=step, rdzv_name=rdzv_name, keys=list(keys),
-            total_bytes=total_bytes,
+            total_bytes=total_bytes, slice_id=self.slice_id,
         )).success
+
+    @retry_rpc(retries=3)
+    def get_slice_status(self, rdzv_name: str = RendezvousName.TRAINING
+                         ) -> dict:
+        """The master's slice registry view + the job step high-water
+        mark ({} = no slice registry / master predates it) — the
+        cross-slice gradient sync's present set
+        (parallel/dcn_sync.py)."""
+        import json
+
+        result = self._get_typed(msg.SliceStatusRequest(
+            node_id=self.node_id, node_rank=self.node_rank,
+            rdzv_name=rdzv_name), msg.SliceStatus)
+        if not result.status_json:
+            return {}
+        try:
+            status = json.loads(result.status_json)
+        except json.JSONDecodeError:
+            return {}
+        return status if isinstance(status, dict) else {}
 
     @retry_rpc(retries=3)
     def get_restore_plan(self, rdzv_name: str = RendezvousName.TRAINING
@@ -351,16 +379,20 @@ class MasterClient:
     # -- health / status --------------------------------------------------
     def report_global_step(self, step: int, step_time_s: float = 0.0,
                            data_wait_fraction: float = -1.0,
-                           mfu: float = -1.0) -> bool:
+                           mfu: float = -1.0,
+                           degraded_steps: int = 0) -> bool:
         """Step progress, optionally with the sender's windowed speed
         evidence (mean step wall time + data-wait fraction from the
         worker's phase timeline, achieved MFU from its FLOPs model) —
         the diagnosis engine's straggler / data-bound / collapse
-        input and the goodput ledger's productive-time accrual."""
+        input and the goodput ledger's productive-time accrual.
+        ``degraded_steps``: steps in this window the sender's slice
+        took with a renormalized (peer-slice-absent) gradient mean."""
         return self._report(msg.GlobalStepReport(
             node_id=self.node_id, step=step, timestamp=time.time(),
             node_rank=self.node_rank, step_time_s=step_time_s,
             data_wait_fraction=data_wait_fraction, mfu=mfu,
+            degraded_steps=degraded_steps,
         )).success
 
     # -- diagnosis --------------------------------------------------------

@@ -402,13 +402,16 @@ class PeerDonorServer:
         return self.addr
 
     def stop(self) -> None:
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        # idempotent under concurrent callers: the agent's run-loop
+        # finally and an external shutdown() may both land here — swap
+        # the fields out first so only one caller tears each down
+        server, self._server = self._server, None
+        thread, self._thread = self._thread, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
 
 
 # ---------------------------------------------------------------------------

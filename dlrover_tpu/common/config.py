@@ -132,6 +132,17 @@ class Context:
             DefaultValues.PEER_RESTORE_TIMEOUT_S
         )
         self.peer_donor_port: int = DefaultValues.PEER_DONOR_PORT
+        # multi-slice hierarchical DP (parallel/dcn_sync.py): degraded-
+        # mode budget while a slice is absent, the per-step DCN collect
+        # deadline, and the wire quantization of the host-level sync
+        self.slice_absent_max_steps: int = (
+            DefaultValues.SLICE_ABSENT_MAX_STEPS
+        )
+        self.dcn_sync_timeout_s: float = DefaultValues.DCN_SYNC_TIMEOUT_S
+        self.dcn_sync_poll_s: float = DefaultValues.DCN_SYNC_POLL_S
+        self.dcn_sync_quant_bits: int = (
+            DefaultValues.DCN_SYNC_QUANT_BITS
+        )
         # step-hang watchdog (trainer/watchdog.py); 0 = disabled
         self.hang_watchdog_s: float = DefaultValues.HANG_WATCHDOG_S
         # per-rank relaunch backoff + quarantine (agent/elastic_agent.py)

@@ -179,6 +179,11 @@ class NodeEnv:
     # k8s-style static notice: a unix timestamp set at pod creation
     # ("this VM goes away at T" — maintenance windows, spot reclaim)
     PREEMPTION_AT = "DLROVER_TPU_PREEMPTION_AT"
+    # ICI slice this host belongs to (multi-slice hierarchical DP):
+    # the slice is the failure domain — rendezvous worlds, drains and
+    # restore-plan donor preference are all scoped by it. -1/unset =
+    # single-slice job (every slice-scoped path disabled).
+    SLICE_ID = "DLROVER_TPU_SLICE_ID"
 
 
 class TrainingMsgLevel:
@@ -209,6 +214,10 @@ class MeshAxis:
     """Canonical named mesh axes (replaces the reference's named process groups,
     atorch/distributed/distributed.py:323 create_parallel_group)."""
 
+    # cross-slice data parallelism over the slow DCN fabric (multi-slice
+    # hierarchical DP): the OUTERMOST axis — gradient sync runs in-slice
+    # over ICI first, then (all-)reduces over this axis
+    DCN = "dcn"
     DATA = "data"
     FSDP = "fsdp"
     TENSOR = "tensor"
@@ -216,7 +225,7 @@ class MeshAxis:
     EXPERT = "expert"
     PIPE = "pipe"
 
-    ALL = ("data", "fsdp", "tensor", "sequence", "expert", "pipe")
+    ALL = ("dcn", "data", "fsdp", "tensor", "sequence", "expert", "pipe")
 
 
 class DefaultValues:
@@ -329,6 +338,24 @@ class DefaultValues:
     # 0 = disabled (the default: legitimate step times vary too much to
     # pick a universal bound; jobs opt in via DLROVER_TPU_HANG_WATCHDOG_S)
     HANG_WATCHDOG_S = 0.0
+    # -- multi-slice hierarchical DP (parallel/dcn_sync.py) -------------
+    # degraded-mode budget: surviving slices keep stepping with the
+    # gradient mean renormalized over PRESENT slices for this many
+    # consecutive steps while a slice is absent (draining/re-forming);
+    # past it they hard-stall with a CRITICAL alert instead of silently
+    # training on a shrunken mean
+    SLICE_ABSENT_MAX_STEPS = 100
+    # per-step deadline for collecting a formed peer slice's gradient
+    # contribution over DCN; a formed slice silent past it is treated
+    # absent for THIS step (degraded accounting, loud warning)
+    DCN_SYNC_TIMEOUT_S = 60.0
+    # cadence of the collector's poll against the master KV store
+    DCN_SYNC_POLL_S = 0.05
+    # int8/int4 groupwise quantization of the host-level cross-slice
+    # gradient payloads (checkpoint/quantized.py codec — the same
+    # scheme quant_collectives puts on the wire in-program); 0 = exact
+    # float32 bytes
+    DCN_SYNC_QUANT_BITS = 0
     # -- per-rank relaunch backoff + quarantine (agent) -----------------
     # exponential delay between worker relaunches: base * 2^(k-1) for the
     # k-th recent failure, capped — a flapping worker must not hot-loop

@@ -177,6 +177,11 @@ class JoinRendezvousRequest(Message):
     # span parent context (obs.current_context()) so the master-side join
     # span shares the agent's trace; {} = sender predates the field
     trace: Dict[str, str] = field(default_factory=dict)
+    # ICI slice this host belongs to (multi-slice hierarchical DP):
+    # activates slice-scoped rendezvous — per-slice worlds and
+    # generation tokens, a slice-local failure re-forms only that
+    # slice. -1 = single-slice job / sender predates the field.
+    slice_id: int = -1
 
 
 @dataclass
@@ -213,6 +218,8 @@ class ReconnectRequest(Message):
     generation: int = 0
     # the last completed round the agent was placed in (-1 = none)
     rdzv_round: int = -1
+    # see JoinRendezvousRequest.slice_id
+    slice_id: int = -1
 
 
 @dataclass
@@ -276,6 +283,9 @@ class PeerStoreReport(Message):
     rdzv_name: str = ""
     keys: List[str] = field(default_factory=list)
     total_bytes: int = 0
+    # donor's ICI slice: restore plans prefer same-slice donors (ICI
+    # bandwidth) before cross-slice (DCN) ones. -1 = no slice.
+    slice_id: int = -1
 
 
 @dataclass
@@ -298,6 +308,27 @@ class RestorePlan(Message):
     epoch: int = 0
     step: int = -1
     found: bool = False
+
+
+@dataclass
+class SliceStatusRequest(Message):
+    """A worker's cross-slice gradient sync asking which slices are
+    currently formed (parallel/dcn_sync.py): the PRESENT set the
+    degraded-mode renormalization divides by."""
+
+    node_id: int = -1
+    node_rank: int = -1
+    rdzv_name: str = ""
+
+
+@dataclass
+class SliceStatus(Message):
+    """JSON {"total": n, "fleet_step": s, "slices": {sid: {"formed":
+    bool, "ranks": [...], "generation": g, "draining": bool}}} — the
+    master's slice registry view plus the job step high-water mark
+    (the re-formed slice's catch-up target)."""
+
+    status_json: str = ""
 
 
 @dataclass
@@ -457,6 +488,10 @@ class GlobalStepReport(Message):
     # sender predates the field or has no FLOPs model — the collapse
     # rule then falls back to raw steps/s.
     mfu: float = -1.0
+    # steps in this report window the sender's slice took in DEGRADED
+    # mode (gradient mean renormalized over present slices while a peer
+    # slice was absent, parallel/dcn_sync.py). 0 = none / predates.
+    degraded_steps: int = 0
 
 
 @dataclass

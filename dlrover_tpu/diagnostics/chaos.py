@@ -38,6 +38,20 @@ Spec grammar (semicolon-separated faults):
                            DLROVER_TPU_HANG_WATCHDOG_S under the block
                            length, the step-hang watchdog fires first:
                            stack dump + self-abort + agent restart
+    kill:slice:0@5         SIGKILL EVERY rank of ICI slice 0 when it
+                           reaches step 5 (multi-slice hierarchical DP:
+                           the rank field addresses the SLICE; each
+                           member matches on its own
+                           $DLROVER_TPU_SLICE_ID and fires at the step,
+                           so the fault fans across the slice) — the
+                           whole-slice failure-domain drill: survivors
+                           keep stepping degraded, the victim slice
+                           re-forms alone
+    preempt:slice:1@4:20   every rank of slice 1 receives the advance
+                           preemption notice at step 4 (20 s grace):
+                           the slice drains AS A UNIT — notice RPC,
+                           slice-wide drain fan-out, emergency saves,
+                           one-round re-formation of the survivors
 
 Each kill/hang/preempt fault fires at most once per process; slow
 applies from its step onward. The hook is a no-op (one env read at construction)
@@ -125,18 +139,27 @@ class ChaosInjector:
 
     def __init__(self, role: str = "worker",
                  rank: Optional[int] = None,
-                 spec: Optional[str] = None):
+                 spec: Optional[str] = None,
+                 slice_id: Optional[int] = None):
         from dlrover_tpu.common.constants import NodeEnv
 
         spec = spec if spec is not None else os.environ.get(CHAOS_ENV, "")
         if rank is None:
             rank = int(os.environ.get(NodeEnv.NODE_RANK, "0"))
+        if slice_id is None:
+            slice_id = int(os.environ.get(NodeEnv.SLICE_ID, "-1"))
         self._role = role
         self._rank = rank
+        self._slice = slice_id
         self._state_dir = os.environ.get(CHAOS_STATE_ENV, "")
+        # a "slice"-role fault addresses the SLICE in its rank field:
+        # every member of that slice arms it, so kill/preempt fan
+        # across the whole failure domain
         self.faults = [
             f for f in parse_chaos(spec)
-            if f.role == role and f.rank == rank
+            if (f.role == role and f.rank == rank)
+            or (f.role == "slice" and role == "worker"
+                and slice_id >= 0 and f.rank == slice_id)
         ] if spec else []
         for fault in self.faults:
             if self._already_fired(fault):
@@ -147,11 +170,16 @@ class ChaosInjector:
 
     def _marker(self, fault: ChaosFault) -> str:
         # keyed by spec index: two faults that agree on
-        # action/role/rank/step still get their own markers
+        # action/role/rank/step still get their own markers. A
+        # slice-role fault additionally keys on THIS node's rank —
+        # every member of the slice must fire its own copy (one shared
+        # marker would let the first member claim the whole slice's
+        # fault and leave the rest alive).
+        per_node = f"_n{self._rank}" if fault.role == "slice" else ""
         return os.path.join(
             self._state_dir,
             f"chaos_{fault.index}_{fault.action}_{fault.role}"
-            f"_{fault.rank}_{fault.at_step}")
+            f"_{fault.rank}_{fault.at_step}{per_node}")
 
     def _already_fired(self, fault: ChaosFault) -> bool:
         return bool(self._state_dir) and os.path.exists(

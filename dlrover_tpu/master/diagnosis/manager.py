@@ -83,19 +83,34 @@ class DiagnosisManager:
             "dlrover_tpu_diagnosis_actions_total",
             "Diagnosis actions dispatched to agent queues",
             labelnames=("kind",))
+        # per-worker gauges carry the rank's slice (multi-slice
+        # hierarchical DP; "-1" on single-slice jobs) so dashboards can
+        # group by failure domain and a departing SLICE evicts as a unit
+        self._slice_map: Dict[int, int] = {}
         self._score_gauge = registry.gauge(
             "dlrover_tpu_worker_straggler_score",
             "Worker mean step time over the fleet median (1.0 = at the "
-            "pack)", labelnames=("node",))
+            "pack)", labelnames=("node", "slice"))
         self._wait_gauge = registry.gauge(
             "dlrover_tpu_worker_data_wait_fraction",
             "Windowed fraction of worker step time spent waiting on "
-            "data", labelnames=("node",))
+            "data", labelnames=("node", "slice"))
         self._mfu_gauge = registry.gauge(
             "dlrover_tpu_worker_mfu",
             "Windowed per-rank achieved model-FLOPs utilization (from "
             "step reports; absent without a FLOPs model)",
-            labelnames=("node",))
+            labelnames=("node", "slice"))
+
+    # -- slice membership (multi-slice hierarchical DP) --------------------
+    def set_slice_map(self, slice_map: Dict[int, int]) -> None:
+        """rank → slice from the rendezvous slice registry (servicer
+        pushes on every slice-carrying join)."""
+        with self._lock:
+            self._slice_map = dict(slice_map)
+
+    def _slice_label(self, rank: int) -> str:
+        with self._lock:
+            return str(self._slice_map.get(rank, -1))
 
     # -- evidence feeds (servicer threads) ---------------------------------
     def observe_resource_stats(self, stats: msg.NodeResourceStats) -> None:
@@ -142,15 +157,21 @@ class DiagnosisManager:
             self._emit(report, Context.singleton())
 
     def observe_drain_notice(self, rank: int, deadline: float,
-                             reason: str = "") -> None:
+                             reason: str = "",
+                             slice_id: int = -1) -> None:
         """A preemption notice arrived for ``rank``: record the planned
-        departure so postmortems show the drain was ADVANCE-notified."""
+        departure so postmortems show the drain was ADVANCE-notified
+        (and, in slice mode, which slice drains as a unit)."""
+        scope = (f"slice {slice_id} drains as a unit"
+                 if slice_id >= 0 else "")
         report = DiagnosisReport(
             rule="preemption", severity="info", worker_id=rank,
             summary=(f"worker {rank} draining: departs in "
                      f"{max(0.0, deadline - time.time()):.0f}s"
-                     + (f" ({reason})" if reason else "")),
-            details={"deadline": deadline, "reason": reason},
+                     + (f" ({reason})" if reason else "")
+                     + (f" [{scope}]" if scope else "")),
+            details={"deadline": deadline, "reason": reason,
+                     "slice": slice_id},
             ts=time.time(),
         )
         with self._diag_lock:
@@ -164,10 +185,24 @@ class DiagnosisManager:
         ranks actually queued. The ``diagnosis_actions_enabled``
         kill-switch still applies: diagnose-only means NO agent-side
         effects, urgent or not."""
+        return self._request_urgent("checkpoint", ranks, deadline,
+                                    reason)
+
+    def request_drain(self, ranks, deadline: float,
+                      reason: str = "") -> List[int]:
+        """Slice-unit drain fan-out: save-and-EXIT actions for the
+        same-slice peers of a rank that received a preemption notice
+        (the whole slice departs together; its world dies with it
+        either way). Same urgency contract as request_checkpoint."""
+        return self._request_urgent("drain", ranks, deadline, reason)
+
+    def _request_urgent(self, kind: str, ranks, deadline: float,
+                        reason: str = "") -> List[int]:
         if not Context.singleton().diagnosis_actions_enabled:
             logger.warning(
-                "diagnosis actions disabled: urgent checkpoint fan-out "
-                "for draining peer suppressed (ranks %s)", list(ranks))
+                "diagnosis actions disabled: urgent %s fan-out "
+                "for draining peer suppressed (ranks %s)", kind,
+                list(ranks))
             return []
         queued: List[int] = []
         now = time.time()
@@ -181,7 +216,7 @@ class DiagnosisManager:
                 self._next_action_id += 1
                 queue.append({
                     "id": action_id,
-                    "kind": "checkpoint",
+                    "kind": kind,
                     "rank": rank,
                     "rule": "preemption",
                     "reason": reason,
@@ -190,9 +225,9 @@ class DiagnosisManager:
                 })
                 queued.append(rank)
         for rank in queued:
-            self._actions_total.labels(kind="checkpoint").inc()
+            self._actions_total.labels(kind=kind).inc()
             obs.get_flight_recorder().record_event(
-                "diagnosis_action", kind="checkpoint", rank=rank,
+                "diagnosis_action", kind=kind, rank=rank,
                 rule="preemption")
         return queued
 
@@ -261,25 +296,36 @@ class DiagnosisManager:
                                ctx: Context) -> None:
         scores = straggler_scores(snap.worker_speeds,
                                   ctx.diagnosis_min_worker_samples)
+        # published keys are (node, slice) label pairs: whole-slice
+        # eviction on slice departure falls out of the set difference —
+        # every member's pair goes stale together
         published = set()
+
+        def _key(rank: int):
+            return str(rank), self._slice_label(rank)
+
         for rank, score in scores.items():
-            self._score_gauge.labels(node=str(rank)).set(score)
-            published.add(rank)
+            node, slice_ = _key(rank)
+            self._score_gauge.labels(node=node, slice=slice_).set(score)
+            published.add((node, slice_))
         for rank, speed in snap.worker_speeds.items():
+            node, slice_ = _key(rank)
             if speed.data_wait_fraction >= 0.0:
-                self._wait_gauge.labels(node=str(rank)).set(
+                self._wait_gauge.labels(node=node, slice=slice_).set(
                     speed.data_wait_fraction)
-                published.add(rank)
+                published.add((node, slice_))
             if speed.mfu >= 0.0:
-                self._mfu_gauge.labels(node=str(rank)).set(speed.mfu)
-                published.add(rank)
+                self._mfu_gauge.labels(node=node, slice=slice_).set(
+                    speed.mfu)
+                published.add((node, slice_))
         with self._lock:
             stale = self._published_scores - published
             self._published_scores = published
-        for rank in stale:  # dead ranks must not keep ranking in scrapes
-            self._score_gauge.remove(node=str(rank))
-            self._wait_gauge.remove(node=str(rank))
-            self._mfu_gauge.remove(node=str(rank))
+        for node, slice_ in stale:
+            # dead ranks must not keep ranking in scrapes
+            self._score_gauge.remove(node=node, slice=slice_)
+            self._wait_gauge.remove(node=node, slice=slice_)
+            self._mfu_gauge.remove(node=node, slice=slice_)
 
     def _emit(self, report: DiagnosisReport, ctx: Context) -> None:
         record = report.to_dict()

@@ -36,6 +36,10 @@ ACTION_RESTART = "restart"
 # announces a preemption drain (the agent writes the worker's drain
 # request file with exit=False)
 ACTION_CHECKPOINT = "checkpoint"
+# save-and-EXIT: fanned out to the SAME-SLICE peers of a draining rank
+# (the slice drains as a unit — its jax world dies with the slice; the
+# agent writes the drain request with exit=True and departs cleanly)
+ACTION_DRAIN = "drain"
 ACTION_ALERT = "alert"
 
 
@@ -386,7 +390,7 @@ def parse_action(action: str) -> Dict[str, Any]:
     kind, _, rank = action.partition(":")
     kind = kind.strip().lower()
     if kind not in (ACTION_OBSERVE, ACTION_PROFILE, ACTION_RESTART,
-                    ACTION_CHECKPOINT, ACTION_ALERT):
+                    ACTION_CHECKPOINT, ACTION_DRAIN, ACTION_ALERT):
         kind = ACTION_OBSERVE
     try:
         target = int(rank) if rank else -1

@@ -46,9 +46,22 @@ class _WaitingNode:
 
 
 class RendezvousManager:
-    """Base rendezvous: collect joiners, cut a round when complete."""
+    """Base rendezvous: collect joiners, cut a round when complete.
+
+    Slice-scoped mode (multi-slice hierarchical DP): when joins carry a
+    slice id (and the manager class opts in via ``slice_scoped``), the
+    SLICE is the failure domain — each slice cuts its own world with its
+    own round counter and generation token, a member death invalidates
+    only that slice's world, and the surviving slices' worlds (and
+    tokens, and worker pids) are untouched. The fleet-level structures
+    (_latest_world/_rdzv_round) stay idle in slice mode; the fleet view
+    is the union of slice worlds."""
 
     name = "base"
+    # slice-scoped worlds apply to training rendezvous; the 2-round
+    # network-check pairing is deliberately fleet-wide (the probe WANTS
+    # cross-slice pairs — DCN links are exactly what it checks)
+    slice_scoped = True
 
     def __init__(self, params: Optional[RendezvousParameters] = None):
         self._params = params or RendezvousParameters()
@@ -91,6 +104,19 @@ class RendezvousManager:
         # failure mid-transfer may have taken the donor (or made the
         # planned world itself stale)
         self._world_epoch = 0
+        # -- slice-scoped failure domains ------------------------------
+        # rank -> slice id, learned from joins/peer-store reports; any
+        # entry (with slice_scoped) switches the manager to per-slice
+        # worlds
+        self._slices: Dict[int, int] = {}
+        self._slice_worlds: Dict[int, Dict[int, int]] = {}
+        # per-slice round counters (what join/get_comm_world speak in
+        # slice mode) and generation tokens — the PER-SLICE layer over
+        # PR 3's global master generation: bumped each time THAT slice's
+        # world cuts, provably untouched when a DIFFERENT slice fails
+        self._slice_rounds: Dict[int, int] = {}
+        self._slice_generation: Dict[int, int] = {}
+        self._slice_round_start: Dict[int, float] = {}
 
     # -- membership (driven by the node manager / event callbacks) --------
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
@@ -126,6 +152,144 @@ class RendezvousManager:
         with self._lock:
             self._last_seen[node_rank] = time.time()
 
+    # -- slice membership (multi-slice hierarchical DP) --------------------
+    def _slice_mode_locked(self) -> bool:
+        """(lock held)"""
+        return self.slice_scoped and bool(self._slices)
+
+    def _record_slice_locked(self, node_rank: int, slice_id: int) -> None:
+        """(lock held)"""
+        if slice_id >= 0 and self.slice_scoped:
+            if self._slices.get(node_rank) != slice_id:
+                self._slices[node_rank] = slice_id
+                self._mutations += 1
+
+    def record_slice(self, node_rank: int, slice_id: int) -> None:
+        """Teach the registry a rank's slice outside the join path
+        (reconnects, peer-store reports that precede the first join)."""
+        with self._lock:
+            self._record_slice_locked(node_rank, slice_id)
+
+    def slice_of(self, node_rank: int) -> int:
+        with self._lock:
+            return self._slices.get(node_rank, -1)
+
+    @property
+    def slice_map(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._slices)
+
+    def slice_members(self, slice_id: int) -> List[int]:
+        with self._lock:
+            return sorted(r for r, s in self._slices.items()
+                          if s == slice_id)
+
+    def slice_status(self) -> Dict:
+        """The registry view the cross-slice gradient sync divides by
+        (parallel/dcn_sync.py): which slices are formed right now, with
+        their generation tokens. JSON-safe."""
+        with self._lock:
+            sids = sorted(set(self._slices.values()))
+            slices = {}
+            for sid in sids:
+                members = sorted(r for r, s in self._slices.items()
+                                 if s == sid)
+                world = self._slice_worlds.get(sid, {})
+                slices[str(sid)] = {
+                    "formed": bool(world),
+                    "ranks": sorted(world) if world else members,
+                    "generation": self._slice_generation.get(sid, 0),
+                    "draining": any(r in self._draining
+                                    for r in members),
+                }
+            return {"total": len(sids), "slices": slices}
+
+    def world_for(self, node_rank: int) -> Dict[int, int]:
+        """The world ``node_rank`` belongs to: its slice's world in
+        slice mode, the fleet world otherwise (the reconnect handler's
+        intact check must compare against the right scope)."""
+        with self._lock:
+            if self._slice_mode_locked() and node_rank in self._slices:
+                return dict(self._slice_worlds.get(
+                    self._slices[node_rank], {}))
+            return dict(self._latest_world)
+
+    def round_for(self, node_rank: int) -> int:
+        """The latest completed round in ``node_rank``'s scope."""
+        with self._lock:
+            if self._slice_mode_locked() and node_rank in self._slices:
+                return self._slice_rounds.get(
+                    self._slices[node_rank], 0) - 1
+            return self._rdzv_round - 1
+
+    def _slice_ready_locked(self, sid: int) -> bool:
+        """A slice's round completes when every alive member joined, or
+        the late-node grace expired with at least one waiting (lock
+        held). node_unit deliberately does not apply: a slice cuts
+        whole — partial slices are what the failure domain forbids."""
+        waiting = {r for r in self._waiting
+                   if self._slices.get(r) == sid}
+        if not waiting:
+            return False
+        alive = {r for r in self._alive_nodes
+                 if self._slices.get(r) == sid}
+        if alive and alive.issubset(set(self._waiting)):
+            return True
+        started = self._slice_round_start.get(sid)
+        return (started is not None
+                and time.time() - started >= self._params.wait_new_node_s)
+
+    def _cut_slice_locked(self, sid: int):
+        """Cut ``sid``'s world from its waiting members (lock held).
+        Returns (sid, round, generation, world, duration) for the
+        caller's obs emission outside the lock."""
+        members = sorted(r for r in self._waiting
+                         if self._slices.get(r) == sid)
+        world = {r: self._waiting[r].local_world_size for r in members}
+        for rank in members:
+            del self._waiting[rank]
+        self._slice_worlds[sid] = world
+        self._slice_rounds[sid] = self._slice_rounds.get(sid, 0) + 1
+        self._slice_generation[sid] = (
+            self._slice_generation.get(sid, 0) + 1)
+        self._mutations += 1
+        started = self._slice_round_start.pop(sid, None)
+        duration = (max(0.0, time.time() - started)
+                    if started is not None else 0.0)
+        logger.info(
+            "%s rendezvous: slice %d round %d cut (generation %d): "
+            "world=%s", self.name, sid, self._slice_rounds[sid] - 1,
+            self._slice_generation[sid], sorted(world))
+        return (sid, self._slice_rounds[sid] - 1,
+                self._slice_generation[sid], dict(world), duration)
+
+    def _emit_slice_cut_obs(self, cut) -> None:
+        """Flight + metrics for a just-cut slice world (called OUTSIDE
+        the manager lock)."""
+        sid, round_idx, generation, world, duration = cut
+        obs.get_flight_recorder().record_event(
+            "slice_world_cut", rdzv=self.name, slice=sid,
+            round=round_idx, generation=generation,
+            world=sorted(world))
+        obs.record_span(
+            "rendezvous_round", duration,
+            attrs={"rdzv": self.name, "round": round_idx, "slice": sid,
+                   "world_size": len(world)})
+        registry = obs.get_registry()
+        registry.counter(
+            "dlrover_tpu_rendezvous_rounds_total",
+            "Completed rendezvous rounds", labelnames=("rdzv",),
+        ).labels(rdzv=self.name).inc()
+        registry.gauge(
+            "dlrover_tpu_slice_generation",
+            "Per-slice generation token: bumped each time THAT slice's "
+            "world re-forms (a peer slice's failure must not move it)",
+            labelnames=("slice",)).labels(slice=str(sid)).set(generation)
+        registry.gauge(
+            "dlrover_tpu_slice_world_size",
+            "Node count of the slice's latest cut world",
+            labelnames=("slice",)).labels(slice=str(sid)).set(len(world))
+
     # -- preemption drain --------------------------------------------------
     def _publish_draining_gauge(self) -> None:
         """Republished by EVERY path that mutates the draining set
@@ -152,7 +316,13 @@ class RendezvousManager:
             if node_rank in self._alive_nodes:
                 self._draining[node_rank] = deadline
                 self._mutations += 1
-            planned = {rank: n for rank, n in self._latest_world.items()
+            if (self._slice_mode_locked()
+                    and node_rank in self._slices):
+                base_world = self._slice_worlds.get(
+                    self._slices[node_rank], {})
+            else:
+                base_world = self._latest_world
+            planned = {rank: n for rank, n in base_world.items()
                        if rank not in self._draining}
         logger.info(
             "%s rendezvous: node %d DRAINING (deadline %.0fs away); "
@@ -189,10 +359,15 @@ class RendezvousManager:
             return self._world_epoch
 
     def register_peer_store(self, node_rank: int, addr: str, step: int,
-                            keys, total_bytes: int = 0) -> None:
+                            keys, total_bytes: int = 0,
+                            slice_id: int = -1) -> None:
         """An agent advertising (or withdrawing: step < 0 / no keys) the
-        staged state its donor server can serve."""
+        staged state its donor server can serve. ``slice_id`` also
+        teaches the slice registry — store reports land BEFORE the
+        join, and a restarted master must know the donor's slice to
+        tier the plan."""
         with self._lock:
+            self._record_slice_locked(node_rank, slice_id)
             if step < 0 or not keys:
                 if self._peer_stores.pop(node_rank, None) is not None:
                     self._mutations += 1
@@ -213,10 +388,11 @@ class RendezvousManager:
         surviving donor serves it. Donors: alive, not draining, staged
         at the newest common step (mixing steps would assemble a state
         that never existed). The requester's own store wins for shards
-        it holds (a local read beats the network); the rest round-robin
-        across donors. Stamped with the world epoch — the staleness
-        guard. Pure dict work under the lock; JSON encoding is the
-        caller's business."""
+        it holds (a local read beats the network); the rest prefer
+        SAME-SLICE donors (ICI bandwidth) before cross-slice (DCN)
+        ones, round-robin within each tier. Stamped with the world
+        epoch — the staleness guard. Pure dict work under the lock;
+        JSON encoding is the caller's business."""
         with self._lock:
             stores = {
                 rank: store
@@ -231,21 +407,38 @@ class RendezvousManager:
             step = max(store["step"] for store in stores.values())
             at_step = {rank: store for rank, store in stores.items()
                        if store["step"] == step}
+            requester_slice = self._slices.get(node_rank, -1)
             holders: Dict[str, List[int]] = {}
             for rank in sorted(at_step):
                 for key in at_step[rank]["keys"]:
                     holders.setdefault(key, []).append(rank)
             entries: Dict[str, Dict] = {}
-            spread = 0
+            # independent round-robin cursors per tier, so the ICI tier
+            # spreads across same-slice donors and the DCN tier across
+            # the rest — one shared cursor would skew whichever tier
+            # the other consumed from
+            spread_same = 0
+            spread_cross = 0
             for key in sorted(holders):
                 ranks = holders[key]
                 if node_rank in ranks:
-                    donor = node_rank
+                    donor, tier = node_rank, "local"
                 else:
-                    donor = ranks[spread % len(ranks)]
-                    spread += 1
+                    same = [r for r in ranks
+                            if requester_slice >= 0
+                            and self._slices.get(r, -1)
+                            == requester_slice]
+                    if same:
+                        donor = same[spread_same % len(same)]
+                        spread_same += 1
+                        tier = "same-slice"
+                    else:
+                        donor = ranks[spread_cross % len(ranks)]
+                        spread_cross += 1
+                        tier = "cross-slice"
                 entries[key] = {"rank": donor,
-                                "addr": at_step[donor]["addr"]}
+                                "addr": at_step[donor]["addr"],
+                                "tier": tier}
             return {
                 "epoch": epoch, "step": step, "entries": entries,
                 "donors": {rank: at_step[rank]["addr"]
@@ -290,9 +483,14 @@ class RendezvousManager:
         (worker finished): survivors keep running, so the cut world stays
         valid for them and must NOT be invalidated — only a death does."""
         invalidated_round = None
+        slice_invalidated = None
         with self._lock:
+            in_slice_world = any(
+                node_rank in world
+                for world in self._slice_worlds.values())
             if (node_rank in self._alive_nodes
-                    or node_rank in self._latest_world):
+                    or node_rank in self._latest_world
+                    or in_slice_world):
                 # a real membership loss: any restore plan computed
                 # before this instant may name the departed rank as a
                 # donor — the epoch bump invalidates it at commit time
@@ -304,7 +502,26 @@ class RendezvousManager:
             # the host's staged state goes with the host
             self._peer_stores.pop(node_rank, None)
             self._mutations += 1
-            if not graceful and node_rank in self._latest_world:
+            if self._slice_mode_locked():
+                # SLICE-SCOPED cut: only the dead rank's slice loses
+                # its world. Every other slice's world, round counter
+                # and generation token are deliberately untouched —
+                # that is the failure-domain contract. (The rank keeps
+                # its slice-map entry: it re-joins as the same slice.)
+                sid = self._slices.get(node_rank, -1)
+                world = self._slice_worlds.get(sid, {})
+                if not graceful and node_rank in world:
+                    logger.info(
+                        "%s rendezvous: node %d died after slice %d "
+                        "round %d was cut; invalidating ONLY that "
+                        "slice's world (fleet unaffected)", self.name,
+                        node_rank, sid,
+                        self._slice_rounds.get(sid, 1) - 1)
+                    self._pending_rejoin |= set(world) - {node_rank}
+                    self._slice_worlds[sid] = {}
+                    slice_invalidated = (
+                        sid, self._slice_rounds.get(sid, 1) - 1)
+            elif not graceful and node_rank in self._latest_world:
                 # A member of the cut round died: any survivor handed this
                 # world would only find out at jax.distributed.initialize
                 # timeout. Empty it so polls report "still forming" and
@@ -322,6 +539,16 @@ class RendezvousManager:
                 invalidated_round = self._rdzv_round - 1
         # obs sinks run OUTSIDE the manager lock (they take their own)
         self._publish_draining_gauge()
+        if slice_invalidated is not None:
+            sid, round_idx = slice_invalidated
+            obs.get_flight_recorder().record_event(
+                "slice_world_invalidated", rdzv=self.name, slice=sid,
+                dead_rank=node_rank, round=round_idx)
+            obs.get_registry().counter(
+                "dlrover_tpu_rendezvous_world_invalidations_total",
+                "Cut worlds invalidated by a member death",
+                labelnames=("rdzv",),
+            ).labels(rdzv=self.name).inc()
         if invalidated_round is not None:
             obs.get_flight_recorder().record_event(
                 "world_invalidated", rdzv=self.name,
@@ -338,9 +565,11 @@ class RendezvousManager:
 
     # -- agent-facing protocol --------------------------------------------
     def join_rendezvous(self, node_rank: int, local_world_size: int,
-                        node_ip: str = "") -> int:
-        """Register a joiner; returns the round it will be placed in."""
+                        node_ip: str = "", slice_id: int = -1) -> int:
+        """Register a joiner; returns the round it will be placed in
+        (its SLICE's round in slice mode)."""
         with self._lock:
+            self._record_slice_locked(node_rank, slice_id)
             self._waiting[node_rank] = _WaitingNode(node_rank,
                                                     local_world_size)
             self._alive_nodes.add(node_rank)
@@ -354,7 +583,20 @@ class RendezvousManager:
             if len(self._waiting) == 1:
                 self._latest_round_start = time.time()
             self._mutations += 1
-            joined_round = self._rdzv_round
+            if (self._slice_mode_locked()
+                    and node_rank in self._slices):
+                sid = self._slices[node_rank]
+                # the slice's grace window is timed from ITS first
+                # waiting member, not the fleet's (test membership,
+                # not rank truthiness — rank 0 is falsy)
+                others_waiting = any(
+                    r != node_rank and self._slices.get(r) == sid
+                    for r in self._waiting)
+                if not others_waiting:
+                    self._slice_round_start[sid] = time.time()
+                joined_round = self._slice_rounds.get(sid, 0)
+            else:
+                joined_round = self._rdzv_round
         obs.get_registry().counter(
             "dlrover_tpu_rendezvous_joins_total",
             "join_rendezvous RPCs accepted", labelnames=("rdzv",),
@@ -379,28 +621,60 @@ class RendezvousManager:
     def get_comm_world(self, node_rank: int
                        ) -> Tuple[int, int, Dict[int, int]]:
         """Poll for the completed world. Returns (round, group, world) —
-        empty world while the round is still forming."""
+        empty world while the round is still forming. In slice mode the
+        world is the polling rank's SLICE world and ``group`` carries
+        the slice id."""
         cut_info = None
+        slice_cut = None
         with self._lock:
             self._last_seen[node_rank] = time.time()
-            if self._check_rdzv_completed():
-                cut_info = self._cut_round()
-            # A node still in the waiting list has re-joined for the NEXT
-            # round — the latest world is stale for it (it may contain dead
-            # peers), so report "still forming".
-            if (node_rank in self._latest_world
-                    and node_rank not in self._waiting):
-                result = self._rdzv_round - 1, 0, dict(self._latest_world)
+            if (self._slice_mode_locked()
+                    and node_rank in self._slices):
+                sid = self._slices[node_rank]
+                if self._slice_ready_locked(sid):
+                    slice_cut = self._cut_slice_locked(sid)
+                world = self._slice_worlds.get(sid, {})
+                if (node_rank in world
+                        and node_rank not in self._waiting):
+                    result = (self._slice_rounds.get(sid, 1) - 1, sid,
+                              dict(world))
+                else:
+                    result = self._slice_rounds.get(sid, 0), sid, {}
             else:
-                result = self._rdzv_round, 0, {}
+                if self._check_rdzv_completed():
+                    cut_info = self._cut_round()
+                # A node still in the waiting list has re-joined for the
+                # NEXT round — the latest world is stale for it (it may
+                # contain dead peers), so report "still forming".
+                if (node_rank in self._latest_world
+                        and node_rank not in self._waiting):
+                    result = (self._rdzv_round - 1, 0,
+                              dict(self._latest_world))
+                else:
+                    result = self._rdzv_round, 0, {}
+        if slice_cut is not None:
+            self._emit_slice_cut_obs(slice_cut)
         if cut_info is not None:
             self._emit_round_obs(cut_info)
         return result
 
-    def num_nodes_waiting(self) -> int:
+    def num_nodes_waiting(self, node_rank: int = -1) -> int:
         """Agents restart workers when >0 while healthy (membership change;
-        reference: training.py:483-486)."""
+        reference: training.py:483-486). In slice mode the signal is
+        scoped to the POLLING rank's slice: a peer slice re-forming must
+        not restart this slice's worker — that is the failure domain."""
         with self._lock:
+            if (self._slice_mode_locked() and node_rank >= 0
+                    and node_rank in self._slices):
+                sid = self._slices[node_rank]
+                members = {r for r, s in self._slices.items()
+                           if s == sid}
+                waiting = set(self._waiting) & members
+                if self._pending_rejoin & members:
+                    return max(1, len(waiting))
+                if not self._slice_worlds.get(sid):
+                    return 0
+                return len(waiting)
             if self._pending_rejoin:
                 # A world member died: every survivor must restart and
                 # re-join; keep the signal raised until each has done so
@@ -416,6 +690,12 @@ class RendezvousManager:
         """Round completes when every alive node joined, or min_nodes joined
         and the late-node grace window expired (lock held)."""
         if not self._waiting:
+            return False
+        if self._slice_mode_locked() and all(
+                rank in self._slices for rank in self._waiting):
+            # slice mode: every waiting rank belongs to a slice — the
+            # per-slice cut path owns them; a sliceless poller must not
+            # sweep them into a fleet round
             return False
         num = min(len(self._waiting), self._params.max_nodes)
         if num < self._params.min_nodes:
@@ -487,7 +767,13 @@ class RendezvousManager:
 
     @property
     def latest_world(self) -> Dict[int, int]:
+        """The fleet view: the union of slice worlds in slice mode."""
         with self._lock:
+            if self._slice_mode_locked():
+                merged: Dict[int, int] = {}
+                for world in self._slice_worlds.values():
+                    merged.update(world)
+                return merged
             return dict(self._latest_world)
 
     @property
@@ -521,6 +807,21 @@ class RendezvousManager:
                              "bytes": s.get("bytes", 0)}
                     for r, s in self._peer_stores.items()
                 },
+                # slice-scoped failure domains: membership, per-slice
+                # worlds and the generation tokens must survive a
+                # master failover — a restarted master that forgot the
+                # tokens would hand every slice a fresh generation and
+                # erase the "untouched survivor" evidence
+                "slices": {str(r): s for r, s in self._slices.items()},
+                "slice_worlds": {
+                    str(sid): {str(r): n for r, n in world.items()}
+                    for sid, world in self._slice_worlds.items()
+                },
+                "slice_rounds": {str(sid): r for sid, r
+                                 in self._slice_rounds.items()},
+                "slice_generation": {
+                    str(sid): g for sid, g
+                    in self._slice_generation.items()},
             }
             # subclass fields join the SAME cut: one lock acquisition,
             # never two cuts with a mutation in between
@@ -569,6 +870,20 @@ class RendezvousManager:
                          "ts": now}
                 for r, s in state.get("peer_stores", {}).items()
             }
+            self._slices = {int(r): int(s) for r, s in
+                            (state.get("slices") or {}).items()}
+            self._slice_worlds = {
+                int(sid): {int(r): int(n) for r, n in world.items()}
+                for sid, world in
+                (state.get("slice_worlds") or {}).items()
+            }
+            self._slice_rounds = {
+                int(sid): int(r) for sid, r in
+                (state.get("slice_rounds") or {}).items()}
+            self._slice_generation = {
+                int(sid): int(g) for sid, g in
+                (state.get("slice_generation") or {}).items()}
+            self._slice_round_start = {}
             # every restored member gets a fresh liveness clock: agents
             # re-register within their poll interval, the genuinely dead
             # age out through the normal reap path
@@ -588,6 +903,10 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
 class NetworkCheckRendezvousManager(RendezvousManager):
     """2-round diagnostic rendezvous (reference: rdzv_manager.py:248-461).
 
+    Deliberately NOT slice-scoped (``slice_scoped = False``): the probe
+    pairs across the whole fleet — cross-slice DCN links are part of
+    what it checks.
+
     Round 0 groups adjacent pairs; round 1 re-pairs fastest-with-slowest so a
     node that failed round 0 is re-tested against a known-good partner. On a
     TPU slice the pair maps to a 2-host sub-mesh probe program (allgather over
@@ -595,6 +914,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
     """
 
     name = "network-check"
+    slice_scoped = False
 
     def __init__(self, params: Optional[RendezvousParameters] = None):
         super().__init__(params)
@@ -668,7 +988,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             round_reports[node_rank] = (normal, elapsed_time)
 
     def join_rendezvous(self, node_rank: int, local_world_size: int,
-                        node_ip: str = "") -> int:
+                        node_ip: str = "", slice_id: int = -1) -> int:
         with self._lock:
             if not self._waiting and self._check_round >= 2:
                 # A full 2-round check cycle was consumed; a new joiner starts
@@ -676,7 +996,8 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 self._reports.clear()
                 self._groups.clear()
                 self._check_round = 0
-        return super().join_rendezvous(node_rank, local_world_size, node_ip)
+        return super().join_rendezvous(node_rank, local_world_size, node_ip,
+                                       slice_id)
 
     def check_fault_node(self) -> Tuple[List[int], int]:
         """Nodes abnormal in ALL reported rounds are faulty (reference:

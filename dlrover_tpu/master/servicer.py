@@ -156,7 +156,11 @@ class MasterServicer:
             if mgr.mutation_count != before:
                 self._sink_state()   # a dead member was reaped
                 self._evict_departed(mgr)
-            return msg.WaitingNodeNum(waiting_num=mgr.num_nodes_waiting())
+            # node_id carries the rank on this RPC (master_client):
+            # slice mode scopes the membership-change signal to the
+            # polling rank's slice
+            return msg.WaitingNodeNum(
+                waiting_num=mgr.num_nodes_waiting(request.node_id))
         if isinstance(request, msg.DiagnosisActionRequest):
             actions = []
             if self.diagnosis_manager is not None:
@@ -179,6 +183,18 @@ class MasterServicer:
             return msg.GoodputReport(report_json=json.dumps(
                 self.goodput_ledger.snapshot(
                     window_s=request.window_s)))
+        if isinstance(request, msg.SliceStatusRequest):
+            import json
+
+            mgr = self.rdzv_managers.get(
+                request.rdzv_name or RendezvousName.TRAINING)
+            if mgr is None:
+                return msg.SliceStatus(status_json="")
+            status = mgr.slice_status()
+            # the re-formed slice's catch-up target (dcn_sync.catch_up)
+            status["fleet_step"] = (
+                self.speed_monitor.completed_global_step)
+            return msg.SliceStatus(status_json=json.dumps(status))
         if isinstance(request, msg.RestorePlanRequest):
             import json
 
@@ -260,13 +276,21 @@ class MasterServicer:
             mgr = self.rdzv_managers[request.rdzv_name]
             # parent under the agent's span so the cross-process timeline
             # (agent rendezvous → master join → round cut) shares a trace
+            slice_id = getattr(request, "slice_id", -1)
             with obs.span("rendezvous_join",
                           {"rank": request.node_rank,
-                           "rdzv": request.rdzv_name},
+                           "rdzv": request.rdzv_name,
+                           "slice": slice_id},
                           parent=getattr(request, "trace", None) or None):
                 rdzv_round = mgr.join_rendezvous(
                     request.node_rank, request.local_world_size,
-                    request.node_ip)
+                    request.node_ip, slice_id)
+            if (slice_id >= 0
+                    and request.rdzv_name == RendezvousName.TRAINING):
+                # keep every slice-labeled consumer's rank→slice view
+                # current (per-worker gauges, goodput states, per-slice
+                # speed aggregates)
+                self._push_slice_map(mgr)
             self._sink_state()
             plan_json = ""
             if request.rdzv_name == RendezvousName.TRAINING:
@@ -318,6 +342,9 @@ class MasterServicer:
                     step_time_s=request.step_time_s,
                     data_wait_fraction=request.data_wait_fraction,
                     mfu=request.mfu)
+            degraded = int(getattr(request, "degraded_steps", 0) or 0)
+            if degraded > 0:
+                self._observe_degraded_steps(rank, degraded)
             self._touch_rendezvous(request.node_rank)
             # deliberately NOT a snapshot trigger (the per-step hot
             # path); the step high-water mark rides on the next
@@ -373,7 +400,8 @@ class MasterServicer:
             if mgr is not None:
                 mgr.register_peer_store(
                     request.node_rank, request.addr, request.step,
-                    request.keys, request.total_bytes)
+                    request.keys, request.total_bytes,
+                    slice_id=getattr(request, "slice_id", -1))
         elif isinstance(request, msg.NodeAddressReport):
             self.kv_store.set(f"node-addr/{request.node_rank}",
                               request.addr.encode())
@@ -429,9 +457,15 @@ class MasterServicer:
         mgr = self.rdzv_managers.get(name)
         if mgr is None:
             return msg.ReconnectResult(generation=self.generation)
+        slice_id = getattr(request, "slice_id", -1)
+        if slice_id >= 0:
+            mgr.record_slice(request.node_rank, slice_id)
         mgr.add_alive_node(request.node_rank)
-        world = mgr.latest_world
-        latest_round = mgr.rdzv_round - 1
+        # slice mode: intact means the rank's SLICE world still holds it
+        # at the round it reported — a peer slice having moved on is
+        # irrelevant to this agent (that is the failure domain)
+        world = mgr.world_for(request.node_rank)
+        latest_round = mgr.round_for(request.node_rank)
         intact = (bool(world) and request.node_rank in world
                   and request.rdzv_round == latest_round)
         restarted = (self.generation != 0
@@ -478,17 +512,51 @@ class MasterServicer:
             logger.info("node %d drain COMPLETE (announced=%s): "
                         "survivors re-form now", rank, announced)
         else:
+            # slice-scoped drain: a preemption notice for ANY rank of a
+            # slice drains the SLICE as a unit — same-slice peers get
+            # save-and-EXIT drain actions (their jax world dies with the
+            # slice anyway), ranks outside it get the save-and-continue
+            # checkpoint fan-out. Single-slice jobs keep the PR 5 shape.
+            training = self.rdzv_managers.get(RendezvousName.TRAINING)
+            sid = training.slice_of(rank) if training is not None else -1
+            slice_peers = []
+            if sid >= 0 and training is not None:
+                slice_peers = [r for r in training.slice_members(sid)
+                               if r != rank]
+            draining_unit = [rank] + slice_peers
             if self.goodput_ledger is not None:
-                self.goodput_ledger.mark_draining(rank, request.deadline)
+                for member in draining_unit:
+                    self.goodput_ledger.mark_draining(member,
+                                                      request.deadline)
             planned = {}
             for name, mgr in self.rdzv_managers.items():
-                world = mgr.mark_draining(rank, request.deadline)
+                unit = (draining_unit
+                        if name == RendezvousName.TRAINING else [rank])
+                for member in unit:
+                    world = mgr.mark_draining(member, request.deadline)
                 if name == RendezvousName.TRAINING:
                     planned = world
-            survivors = sorted(r for r in planned if r != rank)
+            # the checkpoint fan-out targets the FLEET's survivors: in
+            # slice mode the planned world above is the (now empty)
+            # victim slice's — the ranks worth saving are every ALIVE
+            # rank outside the draining unit (alive membership, not cut
+            # worlds: a notice can land before the first world forms)
+            if sid >= 0 and training is not None:
+                survivors = sorted(training.alive_nodes
+                                   - set(draining_unit))
+            else:
+                survivors = sorted(r for r in planned
+                                   if r not in draining_unit)
+            drain_ranks: list = []
             if self.diagnosis_manager is not None:
                 self.diagnosis_manager.observe_drain_notice(
-                    rank, request.deadline, request.reason)
+                    rank, request.deadline, request.reason,
+                    slice_id=sid)
+                if slice_peers:
+                    drain_ranks = self.diagnosis_manager.request_drain(
+                        slice_peers, request.deadline,
+                        reason=f"slice {sid} draining (notice on rank "
+                               f"{rank}): {request.reason}")
                 checkpoint_ranks = (
                     self.diagnosis_manager.request_checkpoint(
                         survivors, request.deadline,
@@ -496,8 +564,9 @@ class MasterServicer:
                                f"{request.reason}"))
             obs.get_flight_recorder().record_event(
                 "node_draining", rank=rank, deadline=request.deadline,
-                reason=request.reason[:256],
+                reason=request.reason[:256], slice=sid,
                 planned_world=sorted(planned),
+                drain_ranks=drain_ranks,
                 checkpoint_ranks=checkpoint_ranks)
         obs.get_registry().counter(
             "dlrover_tpu_drains_total",
@@ -510,6 +579,33 @@ class MasterServicer:
         self._sink_state()
         return msg.DrainResult(success=True,
                                checkpoint_ranks=checkpoint_ranks)
+
+    # ------------------------------------------------------------------
+    def _push_slice_map(self, mgr) -> None:
+        """Fan the rank→slice view to every slice-labeled consumer."""
+        slice_map = mgr.slice_map
+        if not slice_map:
+            return
+        self.speed_monitor.set_slice_map(slice_map)
+        if self.diagnosis_manager is not None:
+            self.diagnosis_manager.set_slice_map(slice_map)
+        if self.goodput_ledger is not None:
+            self.goodput_ledger.set_slice_map(slice_map)
+
+    # ------------------------------------------------------------------
+    def _observe_degraded_steps(self, rank: int, count: int) -> None:
+        """A slice reported degraded steps (gradient mean renormalized
+        while a peer slice was absent): master-side counter labeled by
+        the REPORTING slice + the goodput ledger's per-rank tally."""
+        mgr = self.rdzv_managers.get(RendezvousName.TRAINING)
+        sid = mgr.slice_of(rank) if mgr is not None else -1
+        obs.get_registry().counter(
+            "dlrover_tpu_slice_degraded_steps_total",
+            "Steps a slice took with the gradient mean renormalized "
+            "over present slices (a peer slice was absent)",
+            labelnames=("slice",)).labels(slice=str(sid)).inc(count)
+        if self.goodput_ledger is not None:
+            self.goodput_ledger.observe_degraded_steps(rank, count)
 
     # ------------------------------------------------------------------
     def _sink_state(self) -> None:

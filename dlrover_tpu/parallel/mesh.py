@@ -30,7 +30,14 @@ logger = logging.getLogger(__name__)
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """Sizes of each named parallel dim; 1 = unused. data is inferred when
-    left at 0 (elastic: it absorbs whatever devices remain)."""
+    left at 0 (elastic: it absorbs whatever devices remain).
+
+    ``dcn`` is the explicit hierarchical axis for multi-slice jobs: one
+    mesh coordinate per ICI slice, placed OUTERMOST so every other axis
+    stays inside a slice. Gradient sync then runs hierarchically —
+    in-slice reduce over ICI (data/fsdp), cross-slice (all-)reduce over
+    ``dcn`` (see trainer/train_step.py and
+    parallel/quant_collectives.py)."""
 
     data: int = 0
     fsdp: int = 1
@@ -38,10 +45,11 @@ class MeshSpec:
     sequence: int = 1
     expert: int = 1
     pipe: int = 1
+    dcn: int = 1
 
     def with_total_devices(self, n_devices: int) -> "MeshSpec":
         fixed = (self.fsdp * self.tensor * self.sequence * self.expert
-                 * self.pipe)
+                 * self.pipe * self.dcn)
         if self.data:
             if self.data * fixed != n_devices:
                 raise ValueError(
@@ -57,6 +65,7 @@ class MeshSpec:
 
     def axis_sizes(self) -> List[Tuple[str, int]]:
         return [
+            (MeshAxis.DCN, self.dcn),
             (MeshAxis.DATA, self.data or 1),
             (MeshAxis.FSDP, self.fsdp),
             (MeshAxis.PIPE, self.pipe),
@@ -99,13 +108,22 @@ def _dcn_split(spec: MeshSpec, n_granules: int) -> Optional[List[int]]:
 
     Returns the per-axis DCN shape (same order as ``axis_sizes``), or
     None when no single axis divides evenly by the granule count.
-    Preference order: data, then pipe, then fsdp — gradient all-reduce
-    over data tolerates DCN latency best (it overlaps with backward),
-    pipe crosses the fabric once per microbatch boundary, while
-    tensor/sequence/expert collectives are latency-bound and must stay
-    on ICI (SURVEY §2.5)."""
+    An explicit hierarchical spec (``dcn > 1``) pins the split to the
+    dcn axis — that axis exists precisely to carry the cross-slice
+    dimension. Otherwise preference order: data, then pipe, then fsdp —
+    gradient all-reduce over data tolerates DCN latency best (it
+    overlaps with backward), pipe crosses the fabric once per
+    microbatch boundary, while tensor/sequence/expert collectives are
+    latency-bound and must stay on ICI (SURVEY §2.5)."""
     sizes = spec.axis_sizes()
     dcn = [1] * len(sizes)
+    if spec.dcn > 1:
+        idx = next(i for i, (name, _) in enumerate(sizes)
+                   if name == MeshAxis.DCN)
+        if spec.dcn % n_granules == 0:
+            dcn[idx] = n_granules
+            return dcn
+        return None
     preference = (MeshAxis.DATA, MeshAxis.PIPE, MeshAxis.FSDP)
     for axis in preference:
         idx = next(i for i, (name, _) in enumerate(sizes) if name == axis)
@@ -213,10 +231,20 @@ def current_mesh() -> Optional[Mesh]:
 
 
 def data_axes(mesh: Mesh) -> Tuple[str, ...]:
-    """Axes the batch dim is sharded over (data + fsdp jointly, the
-    standard ZeRO-3 layout)."""
+    """Axes the batch dim is sharded over (dcn + data + fsdp jointly:
+    cross-slice replicas over the DCN axis, then the standard ZeRO-3
+    data+fsdp layout within a slice). Meshes without a dcn axis (built
+    before the hierarchical spec) keep the old pair."""
+    if MeshAxis.DCN in mesh.shape:
+        return (MeshAxis.DCN, MeshAxis.DATA, MeshAxis.FSDP)
     return (MeshAxis.DATA, MeshAxis.FSDP)
 
 
 def dp_size(mesh: Mesh) -> int:
-    return (mesh.shape[MeshAxis.DATA] * mesh.shape[MeshAxis.FSDP])
+    return (mesh.shape.get(MeshAxis.DCN, 1)
+            * mesh.shape[MeshAxis.DATA] * mesh.shape[MeshAxis.FSDP])
+
+
+def dcn_size(mesh: Mesh) -> int:
+    """Slices the mesh spans (1 = single-slice / pre-hierarchical)."""
+    return mesh.shape.get(MeshAxis.DCN, 1)

@@ -64,8 +64,10 @@ def quantized_pmean_leaf(g: jax.Array, axis_name: str, n: int,
                          mode: str = "auto") -> jax.Array:
     """Mean-reduce one gradient leaf over ``axis_name`` with quantized
     wire traffic. Must run inside a shard_map manual over ``axis_name``.
-    """
-    if (not jnp.issubdtype(g.dtype, jnp.floating)
+    ``bits=0`` is the exact escape hatch: a plain pmean over the manual
+    axis (hierarchical meshes reduce over the dcn axis even when the
+    operator wants exact arithmetic on the wire)."""
+    if (bits == 0 or not jnp.issubdtype(g.dtype, jnp.floating)
             or g.size < MIN_QUANT_SIZE):
         return lax.pmean(g, axis_name)
     qmax = 127 if bits == 8 else 7
@@ -112,9 +114,10 @@ def quantized_pmean_leaf(g: jax.Array, axis_name: str, n: int,
 def quantized_pmean(tree: Any, axis_name: str, n: int, bits: int = 8,
                     group_size: int = DEFAULT_GROUP,
                     mode: str = "auto") -> Any:
-    """Tree-wise quantized mean over a manual mesh axis."""
-    if bits not in (8, 4):
-        raise ValueError(f"grad-reduce bits must be 8 or 4, got {bits}")
+    """Tree-wise quantized mean over a manual mesh axis (bits=0 =
+    exact pmean on every leaf)."""
+    if bits not in (8, 4, 0):
+        raise ValueError(f"grad-reduce bits must be 8, 4 or 0, got {bits}")
     fn = functools.partial(quantized_pmean_leaf, axis_name=axis_name,
                            n=n, bits=bits, group_size=group_size,
                            mode=mode)

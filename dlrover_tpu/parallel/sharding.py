@@ -31,9 +31,12 @@ DEFAULT_RULES: List[Tuple[str, Optional[Any]]] = [
     ("expert", MeshAxis.EXPERT),
     ("norm", None),
     # activation layout (consumed by nn.with_logical_constraint in the
-    # models): batch over the joint dp axes, seq/embed unsharded by
-    # default (the sequence axis claims act_seq under SP)
-    ("act_batch", (MeshAxis.DATA, MeshAxis.FSDP)),
+    # models): batch over the joint dp axes — cross-slice dcn replicas
+    # first, then data/fsdp within the slice (dcn is size 1 on
+    # single-slice meshes, so the extra name is a no-op there); seq/
+    # embed unsharded by default (the sequence axis claims act_seq
+    # under SP)
+    ("act_batch", (MeshAxis.DCN, MeshAxis.DATA, MeshAxis.FSDP)),
     ("act_seq", MeshAxis.SEQUENCE),
     ("act_embed", None),
 ]
@@ -85,8 +88,11 @@ def sanitize_shardings(shardings: Any, abstract: Any, mesh: Mesh) -> Any:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Global-batch arrays sharded over (data, fsdp)."""
-    return NamedSharding(mesh, P((MeshAxis.DATA, MeshAxis.FSDP)))
+    """Global-batch arrays sharded over the joint dp axes
+    (dcn + data + fsdp; dcn absent on pre-hierarchical meshes)."""
+    from dlrover_tpu.parallel.mesh import data_axes
+
+    return NamedSharding(mesh, P(data_axes(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

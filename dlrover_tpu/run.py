@@ -41,6 +41,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         help="node count, fixed `N` or elastic `MIN:MAX`")
     parser.add_argument("--node-rank", type=int,
                         default=int(os.getenv(NodeEnv.NODE_RANK, "0")))
+    parser.add_argument("--slice-id", type=int,
+                        default=int(os.getenv(NodeEnv.SLICE_ID, "-1")),
+                        help="ICI slice this host belongs to "
+                             "(multi-slice hierarchical DP; the slice "
+                             "is the failure domain). -1 = single-"
+                             "slice job")
     parser.add_argument("--master-addr",
                         default=os.getenv(NodeEnv.MASTER_ADDR, ""))
     parser.add_argument("--standalone", action="store_true",
@@ -114,7 +120,8 @@ def run(args: argparse.Namespace) -> int:
     # fresh id); heartbeats/failures must carry the id the master tracks
     node_id = int(os.environ.get(NodeEnv.NODE_ID, str(args.node_rank)))
     client = MasterClient(master_addr, node_id=node_id,
-                          node_rank=args.node_rank, node_type=node_type)
+                          node_rank=args.node_rank, node_type=node_type,
+                          slice_id=args.slice_id)
     devices = args.devices_per_node or _detect_devices()
     spec = WorkerSpec(
         entrypoint=entrypoint,

@@ -111,12 +111,37 @@ class ElasticTrainLoop:
 
     def _init_inner(self, model, tx, loss_fn, config, devices,
                     trainer) -> None:
+        from dlrover_tpu.common.constants import NodeEnv
+
+        # multi-slice hierarchical DP: this worker's slice identity.
+        # With a slice id and a master, the gradient sync is two-level —
+        # the jitted step returns the in-slice mean (split grad/apply)
+        # and the cross-slice mean is exchanged host-side over DCN
+        # (parallel/dcn_sync.py), tolerating an absent slice.
+        self._slice_id = int(os.environ.get(NodeEnv.SLICE_ID, "-1"))
+        slice_mode = self._slice_id >= 0 and self.client is not None
+        # the host-level sync moves full gradient/state values through
+        # host memory (np.asarray) — only valid when this process can
+        # address every shard, i.e. a single-process slice world.
+        # Multi-host slices use the single-program hierarchical path
+        # instead (MeshSpec.dcn + the in-program dcn-axis reduce).
+        slice_world = int(os.environ.get(NodeEnv.WORLD_SIZE, "1"))
+        if slice_mode and slice_world > 1:
+            logger.warning(
+                "slice %d spans %d processes: the host-level DCN "
+                "gradient sync needs a single-process slice world — "
+                "disabling it (use the in-program hierarchical mesh, "
+                "MeshSpec.dcn, for multi-host slices)",
+                self._slice_id, slice_world)
+            slice_mode = False
         if trainer is not None:
             self.trainer = trainer
             self.mesh = trainer.mesh
             self.dp = dp_size(self.mesh)
             self.accum = trainer.accum_steps
             self.micro_global = trainer.micro_batch
+            # custom trainers (pipeline) own their step: no split path
+            slice_mode = slice_mode and trainer.grad_fn is not None
         else:
             self.mesh = create_mesh(config.mesh_spec, devices)
             self.dp = dp_size(self.mesh)
@@ -139,7 +164,20 @@ class ElasticTrainLoop:
                     model, tx, self.mesh, sample, loss_fn,
                     accum_steps=self.accum, micro_batch=self.micro_global,
                     rules=config.rules,
+                    split_grad_apply=slice_mode,
                 )
+        self._slice_sync = None
+        if slice_mode:
+            from dlrover_tpu.parallel.dcn_sync import SliceGradSync
+
+            # the slice's process 0 posts payloads; every rank collects
+            is_leader = int(os.environ.get(NodeEnv.PROCESS_ID,
+                                           "0")) == 0
+            self._slice_sync = SliceGradSync(
+                self.client, self._slice_id, is_leader=is_leader,
+                abort_fn=lambda: self._stop_requested.is_set())
+            logger.info("slice-scoped hierarchical DP armed: slice=%d "
+                        "leader=%s", self._slice_id, is_leader)
         self.checkpointer = (
             FlashCheckpointer(config.checkpoint_dir,
                               config.save_interval_steps,
@@ -440,6 +478,12 @@ class ElasticTrainLoop:
                 restore_span.set_attr(key, value)
         if timings:
             logger.info("restore timings: %s", timings)
+        if self._slice_sync is not None:
+            # a re-formed slice behind the fleet adopts the current
+            # state over DCN (restore_source/step above still record
+            # what the RESTORE produced — the catch-up is on top)
+            state, step = self._maybe_slice_catch_up(state, step,
+                                                     sampler)
         self._flush_telemetry()
         return state, step
 
@@ -516,7 +560,11 @@ class ElasticTrainLoop:
             t_data = _time.monotonic()
             self.profiler.poll(step - start_step)
             tok, tgt = self.trainer.shard_batch(tokens, targets)
-            state, raw_metrics = self.trainer.step(state, tok, tgt)
+            if self._slice_sync is not None:
+                state, raw_metrics = self._slice_step(state, tok, tgt,
+                                                      step + 1)
+            else:
+                state, raw_metrics = self.trainer.step(state, tok, tgt)
             step += 1
             # scripted fault injection (no-op unless DLROVER_TPU_CHAOS)
             self._chaos.maybe_inject(step)
@@ -589,6 +637,76 @@ class ElasticTrainLoop:
             self.timeline.export(self._timeline_path)
         self._flush_telemetry()
         return state, metrics
+
+    # -- multi-slice hierarchical DP ---------------------------------------
+    def _slice_step(self, state, tok, tgt, step: int):
+        """One hierarchical step: in-slice grads from the jitted
+        grad_fn, cross-slice mean over DCN (tolerating an absent
+        slice — degraded mode), optimizer update from the fleet mean.
+        The pre-update ``state`` doubles as the rejoin-handoff payload
+        the fleet leader may publish for a re-formed slice."""
+        import jax
+
+        grads, raw_metrics = self.trainer.grad_step(state, tok, tgt)
+        leaves, treedef = jax.tree.flatten(grads)
+        host_leaves = [np.asarray(leaf) for leaf in leaves]
+
+        def _state_leaves():
+            return [np.asarray(leaf) for leaf in jax.tree.leaves(state)]
+
+        reduced, info = self._slice_sync.reduce(
+            host_leaves, step, state_leaves_fn=_state_leaves)
+        if info.get("degraded") or info.get("stalled_s"):
+            obs.get_flight_recorder().record_event(
+                "train_degraded_step", step=step,
+                present=info.get("present"), absent=info.get("absent"),
+                stalled_s=round(float(info.get("stalled_s", 0.0)), 1))
+        fleet_grads = jax.tree.unflatten(treedef, [
+            jax.device_put(leaf, sharding)
+            for leaf, sharding in zip(
+                reduced,
+                jax.tree.leaves(self.trainer.state_shardings.params))
+        ])
+        state, apply_metrics = self.trainer.apply_grads(state,
+                                                        fleet_grads)
+        raw_metrics = dict(raw_metrics)
+        raw_metrics.update(apply_metrics)
+        return state, raw_metrics
+
+    def _maybe_slice_catch_up(self, state, start_step: int, sampler
+                              ) -> Tuple[Any, int]:
+        """A re-formed slice restored at the checkpointed step while
+        the fleet kept (degraded-mode) stepping: adopt the fleet-current
+        state a surviving slice leader publishes over DCN, so this
+        slice resumes in lockstep instead of re-treading steps the
+        survivors already took."""
+        import jax
+
+        result = self._slice_sync.catch_up(start_step)
+        if result is None:
+            return state, start_step
+        leaves, fleet_step = result
+        template_leaves, treedef = jax.tree.flatten(state)
+        if len(leaves) != len(template_leaves):
+            logger.error(
+                "fleet state handoff has %d leaves, local state %d: "
+                "model mismatch — ignoring the handoff",
+                len(leaves), len(template_leaves))
+            return state, start_step
+        shardings = jax.tree.leaves(self.trainer.state_shardings)
+        adopted = jax.tree.unflatten(treedef, [
+            jax.device_put(
+                np.asarray(leaf).astype(tmpl.dtype).reshape(tmpl.shape),
+                sharding)
+            for leaf, tmpl, sharding in zip(leaves, template_leaves,
+                                            shardings)
+        ])
+        if sampler is not None:
+            for _ in range(max(0, fleet_step - start_step)):
+                sampler.record_batch(self.config.global_batch)
+        self.last_restore_timings["catch_up_steps"] = float(
+            fleet_step - start_step)
+        return adopted, fleet_step
 
     # -- preemption drain --------------------------------------------------
     def _consume_drain(self, drain: Dict[str, Any], step, state,
@@ -687,13 +805,17 @@ class ElasticTrainLoop:
         mfu = obs.mfu.achieved_mfu(
             tokens_per_step / mean_step if mean_step > 0 else -1.0,
             self._flops_per_token, self._peak_flops_total)
+        degraded = (self._slice_sync.drain_unreported()
+                    if self._slice_sync is not None else 0)
         try:
             self.client.report_global_step(
                 step, step_time_s=mean_step,
                 data_wait_fraction=stats.get("data_wait_fraction", -1.0),
-                mfu=mfu)
+                mfu=mfu, degraded_steps=degraded)
         except Exception:  # noqa: BLE001 — droppable by contract
-            pass
+            # the degraded tally must not vanish with a dropped report
+            if degraded and self._slice_sync is not None:
+                self._slice_sync.degraded_unreported += degraded
         # tail-only AND wall-clock throttled on the hot path: the
         # write+rename alone costs ~1 ms on slow filesystems, so fast
         # steps with a short report interval would blow the < 1 %

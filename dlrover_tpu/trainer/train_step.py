@@ -61,6 +61,13 @@ class ShardedTrainer:
     accum_steps: int
     micro_batch: int
     batch_abstract: Optional[jax.ShapeDtypeStruct] = None
+    # split-step programs (build_trainer(split_grad_apply=True)): the
+    # host-level cross-slice gradient sync (parallel/dcn_sync.py) needs
+    # the in-slice-reduced gradient OUT of the program and the fleet-
+    # reduced gradient back IN before the optimizer update. None on
+    # fused-step trainers.
+    grad_fn: Any = dataclasses.field(default=None, repr=False)
+    apply_fn: Any = dataclasses.field(default=None, repr=False)
     _compiled_step: Any = dataclasses.field(default=None, repr=False)
     precompile_timings: dict = dataclasses.field(default_factory=dict)
     last_used_aot: bool = False
@@ -144,6 +151,29 @@ class ShardedTrainer:
         self.last_used_aot = False
         return self.step_fn(state, tokens, targets)
 
+    def grad_step(self, state: TrainState, tokens, targets):
+        """Forward+backward only: (slice-mean grads, metrics). The
+        caller reduces the grads across slices (host-level DCN sync)
+        before `apply_grads`. Only on split-built trainers."""
+        import time as _time
+
+        if self.grad_fn is None:
+            raise RuntimeError("trainer was not built with "
+                               "split_grad_apply=True")
+        t0 = _time.monotonic()
+        try:
+            return self.grad_fn(state, tokens, targets)
+        finally:
+            self.last_step_dispatch_s = _time.monotonic() - t0
+
+    def apply_grads(self, state: TrainState, grads):
+        """Optimizer update from (fleet-reduced) grads → (new_state,
+        metrics)."""
+        if self.apply_fn is None:
+            raise RuntimeError("trainer was not built with "
+                               "split_grad_apply=True")
+        return self.apply_fn(state, grads)
+
     def shard_batch(self, tokens, targets):
         """Host numpy (global_batch, seq) → device arrays shaped
         (accum, micro, seq) with the micro axis over (data, fsdp)."""
@@ -172,7 +202,8 @@ def build_trainer(
     offload_opt_state: bool = False,
     rng_seed: int = 0,
     grad_reduce_bits: int = 0,
-    grad_reduce_axis: str = MeshAxis.DATA,
+    grad_reduce_axis: Optional[str] = None,
+    split_grad_apply: bool = False,
 ) -> ShardedTrainer:
     """Lower (model, optimizer, mesh) into init/step programs.
 
@@ -187,12 +218,25 @@ def build_trainer(
     state's HBM at the cost of PCIe/DMA traffic per step.
 
     grad_reduce_bits: 8/4 = the gradient mean over ``grad_reduce_axis``
-    (the data axis — the one `_dcn_split` routes across the slow DCN
-    fabric on multi-slice jobs) runs through the quantized collective
+    runs through the quantized collective
     (parallel/quant_collectives.py, the reference quant_reduce.cu
     analog) instead of XLA's implicit fp psum: the whole step is wrapped
     in a shard_map manual over that one axis, every other axis stays
     auto. 0 = exact reduce (default).
+
+    grad_reduce_axis: None resolves hierarchically — the ``dcn`` axis
+    when the mesh spans slices (dcn > 1), else ``data``. A dcn reduce
+    makes the gradient sync explicitly two-level: the in-slice mean
+    rides XLA's implicit psum over the (data, fsdp) axes inside each
+    slice block, then the cross-slice mean (all-)reduces over the
+    manual dcn axis — quantized when ``grad_reduce_bits`` asks for it,
+    exact pmean otherwise.
+
+    split_grad_apply: additionally build ``grad_fn``/``apply_fn`` —
+    the two halves of the step around a HOST-level cross-slice
+    gradient sync (parallel/dcn_sync.py): grad_fn returns the
+    in-slice-reduced grads, the host exchanges them over DCN
+    (tolerating an absent slice), apply_fn applies the fleet mean.
     """
     rules = list(rules if rules is not None else DEFAULT_RULES)
 
@@ -231,10 +275,13 @@ def build_trainer(
                     mesh, s.spec, memory_kind=host_kind),
                 state_shardings.opt_state, abstract_opt,
             ))
-    # Batch (accum, micro, seq): micro over the joint dp axes, seq over the
+    # Batch (accum, micro, seq): micro over the joint dp axes (dcn +
+    # data + fsdp — cross-slice replicas outermost), seq over the
     # sequence axis (a no-op at sequence=1; shards inputs for SP runs).
+    from dlrover_tpu.parallel.mesh import data_axes
+
     batch_shard = NamedSharding(
-        mesh, P(None, (MeshAxis.DATA, MeshAxis.FSDP), MeshAxis.SEQUENCE)
+        mesh, P(None, data_axes(mesh), MeshAxis.SEQUENCE)
     )
 
     def _init(rng):
@@ -250,8 +297,10 @@ def build_trainer(
         with use_mesh(mesh), nn.logical_axis_rules(rules):
             return _train_step_body(state, tokens, targets)
 
-    def _train_step_body(state: TrainState, tokens, targets,
-                         grad_reduce=None):
+    def _accumulate(state: TrainState, tokens, targets):
+        """The microbatch scan: (loss_sum, f32 grad_sum) before any
+        explicit cross-axis reduce or the optimizer update — the shared
+        core of the fused step and the split grad_fn."""
         params = state.params
         # Deterministic per-step rng streams for stochastic model paths
         # (MoE gating jitter, dropout): folded from the step counter so
@@ -289,40 +338,69 @@ def build_trainer(
             micro_step, (jnp.zeros((), jnp.float32), zero_grads),
             (tokens, targets, jnp.arange(accum_steps)),
         )
+        return loss_sum, grad_sum
+
+    def _apply_body(state: TrainState, grads):
+        """Optimizer update from already-reduced grads (param dtype):
+        (new_state, grad_norm)."""
+        updates, new_opt = tx.update(grads, state.opt_state,
+                                     state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt)
+        return new_state, optax.global_norm(grads)
+
+    def _train_step_body(state: TrainState, tokens, targets,
+                         grad_reduce=None):
+        loss_sum, grad_sum = _accumulate(state, tokens, targets)
         if grad_reduce is not None:
-            # explicit (quantized) mean over the manual reduce axis; the
-            # loss metric reduces exactly (it's a scalar)
+            # explicit (possibly quantized) mean over the manual reduce
+            # axis — the cross-slice half of the hierarchical sync; the
+            # in-slice half already happened through XLA's implicit
+            # psum over the auto (data, fsdp) axes. The loss metric
+            # reduces exactly (it's a scalar).
             grad_sum = grad_reduce(grad_sum)
             loss_sum = jax.lax.pmean(loss_sum, grad_reduce_axis)
         grads = jax.tree.map(
-            lambda g, p: (g / accum_steps).astype(p.dtype), grad_sum, params
+            lambda g, p: (g / accum_steps).astype(p.dtype), grad_sum,
+            state.params
         )
-        updates, new_opt = tx.update(grads, state.opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        new_state = TrainState(step=state.step + 1, params=new_params,
-                               opt_state=new_opt)
+        new_state, grad_norm = _apply_body(state, grads)
         metrics = {
             "loss": loss_sum / accum_steps,
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
         }
         return new_state, metrics
 
+    if grad_reduce_axis is None:
+        # hierarchical by default: a mesh spanning slices reduces over
+        # the dcn axis (in-slice implicit + cross-slice explicit)
+        grad_reduce_axis = (MeshAxis.DCN
+                            if mesh.shape.get(MeshAxis.DCN, 1) > 1
+                            else MeshAxis.DATA)
     n_reduce = mesh.shape.get(grad_reduce_axis, 1)
     from dlrover_tpu.common.jax_compat import HAS_PARTIAL_AUTO, shard_map
 
-    if (grad_reduce_bits and n_reduce > 1 and not HAS_PARTIAL_AUTO
+    # the dcn axis always reduces explicitly (the hierarchical
+    # contract), quantized or not; other axes only when quantized
+    wrap_reduce = n_reduce > 1 and (
+        bool(grad_reduce_bits) or grad_reduce_axis == MeshAxis.DCN)
+    if (wrap_reduce and not HAS_PARTIAL_AUTO
             and len([a for a, n in mesh.shape.items() if n > 1]) > 1):
-        # the quantized reduce needs a shard_map manual over ONE axis of a
-        # multi-axis mesh; without partial-auto support that program
-        # cannot be built — train exactly instead of not at all
+        # the explicit reduce needs a shard_map manual over ONE axis of
+        # a multi-axis mesh; without partial-auto support that program
+        # cannot be built — train exactly instead of not at all (the
+        # flat implicit mean over (dcn, data, fsdp) is numerically the
+        # hierarchical mean of equal-size slice means)
         from dlrover_tpu.common.log import default_logger
 
         default_logger.warning(
-            "grad_reduce_bits=%d requested but this jax has no "
-            "partial-auto shard_map; falling back to the exact reduce",
-            grad_reduce_bits)
+            "grad reduce over %r (bits=%d) needs a partial-auto "
+            "shard_map this jax lacks; falling back to the exact flat "
+            "reduce", grad_reduce_axis, grad_reduce_bits)
         grad_reduce_bits = 0
-    if grad_reduce_bits and n_reduce > 1:
+        wrap_reduce = False
+    if wrap_reduce:
         from jax.sharding import PartitionSpec
 
         from dlrover_tpu.parallel.quant_collectives import quantized_pmean
@@ -375,6 +453,41 @@ def build_trainer(
         donate_argnums=(0,) if donate_state else (),
     )
 
+    grad_fn = apply_fn = None
+    if split_grad_apply:
+        # the two halves around a host-level cross-slice sync: grad_fn's
+        # output is the in-slice mean (XLA's implicit psum over the auto
+        # dp axes of THIS program's world — one slice in the elastic
+        # multi-world mode), apply_fn takes the fleet-reduced mean back.
+        # grad_fn must NOT donate the state: apply_fn still reads it.
+        def _grad_only(state, tokens, targets):
+            with use_mesh(mesh), nn.logical_axis_rules(rules):
+                loss_sum, grad_sum = _accumulate(state, tokens, targets)
+                grads = jax.tree.map(
+                    lambda g, p: (g / accum_steps).astype(p.dtype),
+                    grad_sum, state.params)
+                return grads, {"loss": loss_sum / accum_steps}
+
+        def _apply_only(state, grads):
+            with use_mesh(mesh), nn.logical_axis_rules(rules):
+                new_state, grad_norm = _apply_body(state, grads)
+                return new_state, {"grad_norm": grad_norm}
+
+        grads_shardings = state_shardings.params
+        # NO donation on grad_fn by design: the same state is re-read
+        # by apply_fn after the host-level cross-slice exchange
+        grad_fn = jax.jit(  # graftlint: disable=GL104
+            _grad_only,
+            in_shardings=(state_shardings, batch_shard, batch_shard),
+            out_shardings=(grads_shardings, None),
+        )
+        apply_fn = jax.jit(
+            _apply_only,
+            in_shardings=(state_shardings, grads_shardings),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
     return ShardedTrainer(
         mesh=mesh,
         init_fn=init_fn,
@@ -383,6 +496,8 @@ def build_trainer(
         batch_sharding=batch_shard,
         accum_steps=accum_steps,
         micro_batch=micro_batch,
+        grad_fn=grad_fn,
+        apply_fn=apply_fn,
         batch_abstract=jax.ShapeDtypeStruct(
             (accum_steps, micro_batch, *sample_batch.shape[1:]),
             jnp.int32, sharding=batch_shard),

@@ -380,7 +380,7 @@ class TestMfuExposition:
                 '1' in rendered)
         assert "dlrover_tpu_goodput_fraction 0.5" in rendered
         assert ('dlrover_tpu_worker_goodput_state{node="0",'
-                'state="draining"} 1' in rendered)
+                'slice="-1",state="draining"} 1' in rendered)
 
 
 # -- rules ------------------------------------------------------------------

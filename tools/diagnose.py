@@ -146,6 +146,50 @@ def render_restore(payload: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# flight events describing the slice failure-domain lifecycle
+# (multi-slice hierarchical DP: per-slice worlds, degraded mode,
+# rejoin catch-up — master/rendezvous.py + parallel/dcn_sync.py)
+_SLICE_EVENTS = (
+    "slice_world_cut", "slice_world_invalidated", "slice_degraded",
+    "slice_absent_budget_blown", "slice_state_handoff",
+    "slice_rejoin_catchup", "train_degraded_step",
+)
+
+
+def render_slices(payload: Dict[str, Any]) -> str:
+    """Per-slice section of a flight dump: which slice's world cut or
+    died (with its generation token), the degraded-mode episodes, and
+    the rejoin catch-up — the one-glance answer to "did losing slice S
+    touch the survivors, and how many renormalized steps did they
+    take?"."""
+    events = [record for record in payload.get("events", [])
+              if record.get("kind") == "event"
+              and record.get("name") in _SLICE_EVENTS]
+    lines = [f"slice failure-domain events: {len(events)}"]
+    if not events:
+        return "\n".join(lines)
+    ordered = sorted(events, key=lambda e: e.get("ts", 0.0))
+    t0 = ordered[0].get("ts", 0.0)
+    degraded_by_slice: Dict[Any, int] = {}
+    for record in ordered:
+        attrs = dict(record.get("attrs", {}))
+        if record.get("name") == "train_degraded_step":
+            for sid in attrs.get("present") or []:
+                degraded_by_slice[sid] = degraded_by_slice.get(sid,
+                                                               0) + 1
+            continue  # per-step rows roll up below instead of spamming
+        detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        lines.append("+{offset:8.1f}s  {name:<26} {detail}".format(
+            offset=record.get("ts", 0.0) - t0,
+            name=str(record.get("name", "?")),
+            detail=detail).rstrip())
+    for sid in sorted(degraded_by_slice):
+        lines.append(
+            f"  slice {sid}: {degraded_by_slice[sid]} degraded "
+            f"step(s) (renormalized gradient mean)")
+    return "\n".join(lines)
+
+
 def render_goodput(payload: Dict[str, Any]) -> str:
     """Goodput-ledger section of a flight dump: the bucket split plus
     the per-incarnation badput attribution (obs/goodput.py). Dumps
@@ -252,6 +296,7 @@ def main(argv=None) -> int:
         print(render_reports(reports_from_flight(payload)))
         print(render_lifecycle(payload))
         print(render_restore(payload))
+        print(render_slices(payload))
         print(render_goodput(payload))
     for path in ns.timeline:
         payload = _load_json(path)
