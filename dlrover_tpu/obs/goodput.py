@@ -116,6 +116,15 @@ class GoodputLedger:
         self._last_step: Dict[int, int] = {}
         self._last_report_ts: Dict[int, float] = {}
         self._mfu: Dict[int, float] = {}
+        # multi-slice hierarchical DP: rank → slice (rendezvous slice
+        # registry), per-rank degraded-step tallies (steps taken with
+        # the gradient mean renormalized while a peer slice was
+        # absent), and the slice label each rank's state gauge was
+        # published under (removal must match the labels it was set
+        # with, even across a slice-map update)
+        self._slice_map: Dict[int, int] = {}
+        self._degraded_steps: Dict[int, int] = {}
+        self._state_slice: Dict[int, str] = {}
         self._seen_span_ids: deque = deque(maxlen=_SEEN_SPAN_CAP)
         self._seen_set: set = set()
         # (ts, rank, bucket, seconds) for windowed summaries
@@ -139,7 +148,7 @@ class GoodputLedger:
         self._state_gauge = registry.gauge(
             "dlrover_tpu_worker_goodput_state",
             "1 for the rank's current activity state",
-            labelnames=("node", "state"))
+            labelnames=("node", "slice", "state"))
         registry.gauge(
             "dlrover_tpu_goodput_fraction",
             "Cumulative productive fraction of the job's rank-seconds",
@@ -195,10 +204,35 @@ class GoodputLedger:
         if change is None:
             return
         rank, old, new = change
-        if old:
-            self._state_gauge.remove(node=str(rank), state=old)
+        with self._lock:
+            old_slice = self._state_slice.get(rank)
+            new_slice = str(self._slice_map.get(rank, -1))
+            if new:
+                self._state_slice[rank] = new_slice
+            else:
+                self._state_slice.pop(rank, None)
+        if old and old_slice is not None:
+            self._state_gauge.remove(node=str(rank), slice=old_slice,
+                                     state=old)
         if new:
-            self._state_gauge.labels(node=str(rank), state=new).set(1)
+            self._state_gauge.labels(node=str(rank), slice=new_slice,
+                                     state=new).set(1)
+
+    # -- slice membership (multi-slice hierarchical DP) --------------------
+    def set_slice_map(self, slice_map: Dict[int, int]) -> None:
+        with self._lock:
+            self._slice_map = dict(slice_map)
+
+    def observe_degraded_steps(self, rank: int, count: int) -> None:
+        """``count`` degraded steps reported by ``rank``'s slice: the
+        gradient mean was renormalized over present slices while a peer
+        slice was absent. Tallied per rank for the snapshot/tools view
+        (the labeled counter series is the servicer's)."""
+        if count <= 0:
+            return
+        with self._lock:
+            self._degraded_steps[rank] = (
+                self._degraded_steps.get(rank, 0) + int(count))
 
     # -- evidence feeds ----------------------------------------------------
     def observe_span(self, record: Dict[str, Any],
@@ -415,6 +449,8 @@ class GoodputLedger:
                     "state": self._state.get(rank, ""),
                     "gone": rank in self._gone,
                     "mfu": round(self._mfu.get(rank, -1.0), 4),
+                    "slice": self._slice_map.get(rank, -1),
+                    "degraded_steps": self._degraded_steps.get(rank, 0),
                     "buckets": {b: round(s, 3)
                                 for b, s in table.items() if s > 0.0},
                 }
@@ -441,6 +477,8 @@ class GoodputLedger:
                 if total_elapsed > 0 else 0.0,
                 "per_rank": per_rank,
                 "incarnations": incarnations,
+                "degraded_steps_total": sum(
+                    self._degraded_steps.values()),
             }
         if window_s > 0.0:
             snap["window"] = self.window_summary(window_s)
@@ -532,6 +570,11 @@ class GoodputLedger:
                 "incarnations": [dict(inc, badput_buckets=dict(
                     inc.get("badput_buckets", {})))
                     for inc in self._incarnations],
+                "slices": {str(r): s
+                           for r, s in self._slice_map.items()},
+                "degraded_steps": {
+                    str(r): n
+                    for r, n in self._degraded_steps.items()},
             }
 
     def restore_state(self, state: dict) -> None:
@@ -561,6 +604,11 @@ class GoodputLedger:
                           (state.get("gone") or {}).items()}
             self._last_step = {int(r): int(s) for r, s in
                                (state.get("last_step") or {}).items()}
+            self._slice_map = {int(r): int(s) for r, s in
+                               (state.get("slices") or {}).items()}
+            self._degraded_steps = {
+                int(r): int(n) for r, n in
+                (state.get("degraded_steps") or {}).items()}
             # report timestamps deliberately restart: the next report's
             # delta spans the outage and must clamp to zero wall
             self._last_report_ts.clear()
@@ -613,6 +661,35 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
                 window.get("dominant_badput") or "-",
                 float(window.get("dominant_badput_s", 0.0))))
     per_rank = snap.get("per_rank", {})
+    # per-slice rollup (multi-slice hierarchical DP): grouped by
+    # failure domain, with the degraded-step tally front and center
+    slice_rows: Dict[Any, List[Dict[str, Any]]] = {}
+    for row in per_rank.values():
+        sid = row.get("slice", -1)
+        if sid is not None and int(sid) >= 0:
+            slice_rows.setdefault(int(sid), []).append(row)
+    degraded_total = int(snap.get("degraded_steps_total", 0) or 0)
+    if slice_rows:
+        lines.append("per slice:")
+        for sid in sorted(slice_rows):
+            rows = slice_rows[sid]
+            elapsed_s = sum(float(r.get("elapsed_s", 0.0))
+                            for r in rows)
+            productive = sum(
+                float(r.get("buckets", {}).get(PRODUCTIVE, 0.0))
+                for r in rows)
+            degraded = sum(int(r.get("degraded_steps", 0))
+                           for r in rows)
+            fraction = productive / elapsed_s if elapsed_s > 0 else 0.0
+            gone = all(r.get("gone") for r in rows)
+            lines.append(
+                f"  slice {sid:>3}  {len(rows)} rank(s)  "
+                f"{elapsed_s:8.1f}s elapsed  goodput {fraction:6.1%}  "
+                f"degraded_steps={degraded}"
+                + ("  [gone]" if gone else ""))
+    elif degraded_total:
+        lines.append(f"degraded steps (renormalized gradient mean): "
+                     f"{degraded_total}")
     if per_rank:
         lines.append("per rank:")
         for rank in sorted(per_rank, key=lambda r: int(r)):
