@@ -1,0 +1,231 @@
+"""Online re-plan benchmark: time-to-first-step after a resize, replan
+path vs the forced checkpoint (Orbax) round-trip.
+
+The story being measured (ISSUE 11 / ROADMAP item 1): a world resize to
+a divisor-unfriendly size used to force the same DP shape (crash on a
+non-divisor batch) or a full checkpoint round-trip. The planner
+(parallel/planner.py) now picks a DP×TP×PP mesh for ANY world size at
+the rendezvous cut, and the live state migrates from the host-RAM peer
+cache under the NEW sharding — no storage round-trip.
+
+This bench runs the real stack in one process against a standalone
+JobMaster: a world of N chips trains past a committed checkpoint +
+peer stage, then resizes to N−1 and N+1 (both divisor-unfriendly for
+the batch). For each resize it clocks loop rebuild → plan → migrate →
+first completed step, twice:
+
+- ``replan``       — the plan rides the join/RPC, state migrates from
+                     the peer cache under the new sharding,
+- ``forced_orbax`` — peer restore disabled: the same re-plan but the
+                     state takes the checkpoint round-trip.
+
+Prints ONE JSON line:
+    {"metric": "replan_time_to_first_step_seconds", "value": S, ...,
+     "scenarios": {"shrink": {...}, "grow": {...}}}
+
+with per-scenario phase breakdowns and phase_coverage (exclusive
+phases must explain the headline number).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+GLOBAL_BATCH = 10          # divisor-unfriendly for both 4 and 6 chips
+SEQ_LEN = 32
+BASE_DEVICES = 5
+SAVE_INTERVAL = 1
+WARM_STEPS = 3
+
+
+def _ensure_cpu_devices(n: int) -> None:
+    """Before jax imports: enough virtual CPU devices for the largest
+    world this bench builds (no-op on real accelerators)."""
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and \
+            "JAX_PLATFORMS" not in os.environ:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def _batches(vocab: int, batch: int, seq: int, n: int, seed: int):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        tokens = rng.integers(0, vocab, (batch, seq), dtype=np.int64)
+        yield tokens, tokens
+
+
+def _resize_once(model, tx, loss_fn, config, client, devices,
+                 target: int, forced_orbax: bool) -> dict:
+    """One clocked resize: re-join with the target chip count (ONE
+    rendezvous round — the join stamps the plan), rebuild the loop,
+    restore/migrate, run the first step."""
+    import jax
+
+    from dlrover_tpu.trainer.elastic_loop import ElasticTrainLoop
+
+    t0 = time.perf_counter()
+    client.join_rendezvous(target)
+    while True:
+        _, _, world = client.get_comm_world()
+        if world:
+            break
+        time.sleep(0.01)
+    t_join = time.perf_counter()
+    loop = ElasticTrainLoop(model, tx, loss_fn, config,
+                            master_client=client,
+                            devices=devices[:target])
+    if forced_orbax:
+        # the comparison leg: same re-plan, but the state takes the
+        # checkpoint round-trip (staging stays on so later scenarios
+        # keep a live peer cache)
+        loop._peer_restorer = None
+    t_build = time.perf_counter()
+    try:
+        state, start = loop.restore_or_init(jax.random.PRNGKey(0))
+        t_restore = time.perf_counter()
+        state, metrics = loop.run(
+            state,
+            _batches(256, config.global_batch, config.seq_len, 1,
+                     seed=start),
+            start_step=start)
+        t_first = time.perf_counter()
+        timings = dict(loop.last_restore_timings)
+        breakdown = {
+            "rendezvous_s": round(t_join - t0, 3),
+            "loop_build_s": round(t_build - t_join, 3),
+            "restore_s": round(t_restore - t_build, 3),
+            "first_step_s": round(t_first - t_restore, 3),
+        }
+        elapsed = t_first - t0
+        phase_sum = sum(breakdown.values())
+        result = {
+            "time_to_first_step_s": round(elapsed, 3),
+            "restored_step": start,
+            "stepped_to": int(metrics.get("step", -1)),
+            "restore_source": loop.last_restore_source,
+            "replan_applied": loop._replan_applied,
+            "mesh": dict(loop.mesh.shape),
+            "global_batch": loop.global_batch,
+            "breakdown": breakdown,
+            "restore_timings": {k: v for k, v in timings.items()
+                                if isinstance(v, (int, float))},
+            "phase_sum_s": round(phase_sum, 3),
+            "phase_coverage": round(phase_sum / elapsed, 3)
+            if elapsed > 0 else 0.0,
+        }
+        return result
+    finally:
+        loop.close()
+
+
+def run_bench() -> dict:
+    import jax
+    import optax
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.constants import NodeEnv
+    from dlrover_tpu.master.job_master import JobMaster
+    from dlrover_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        cross_entropy_loss,
+    )
+    from dlrover_tpu.trainer.elastic_loop import (
+        ElasticTrainLoop,
+        TrainLoopConfig,
+    )
+
+    workdir = tempfile.mkdtemp(prefix="bench-replan-")
+    os.environ[NodeEnv.PEER_CACHE_DIR] = os.path.join(workdir, "cache")
+
+    devices = jax.devices()
+    if len(devices) < BASE_DEVICES + 1:
+        raise SystemExit(
+            f"need {BASE_DEVICES + 1} devices, have {len(devices)} "
+            f"(CPU: the bench exports "
+            f"xla_force_host_platform_device_count itself — run it "
+            f"directly, not under an inherited XLA_FLAGS)")
+
+    cfg = LlamaConfig.tiny(attn_impl="reference", norm_impl="reference")
+    model = Llama(cfg)
+    tx = optax.adamw(3e-4)
+    config = TrainLoopConfig(
+        global_batch=GLOBAL_BATCH, seq_len=SEQ_LEN,
+        checkpoint_dir=os.path.join(workdir, "ckpt"),
+        save_interval_steps=SAVE_INTERVAL,
+        report_interval_steps=1,
+    )
+
+    master = JobMaster(min_nodes=1, max_nodes=1, host="127.0.0.1")
+    master.prepare()
+    client = MasterClient(master.addr, node_id=0, node_rank=0)
+    try:
+        # phase 0: the base world trains past a committed checkpoint +
+        # peer stage (what the resize will migrate from)
+        client.join_rendezvous(BASE_DEVICES)
+        loop = ElasticTrainLoop(model, tx, cross_entropy_loss, config,
+                                master_client=client,
+                                devices=devices[:BASE_DEVICES])
+        state, start = loop.restore_or_init(jax.random.PRNGKey(0))
+        state, metrics = loop.run(
+            state, _batches(cfg.vocab_size, GLOBAL_BATCH, SEQ_LEN,
+                            WARM_STEPS, seed=0),
+            start_step=start)
+        base_step = int(metrics["step"])
+        loop.close()
+
+        scenarios = {}
+        for name, target in (("shrink", BASE_DEVICES - 1),
+                             ("grow", BASE_DEVICES + 1)):
+            scenarios[name] = {
+                "target_devices": target,
+                "replan": _resize_once(
+                    model, tx, cross_entropy_loss, config, client,
+                    devices, target, forced_orbax=False),
+                "forced_orbax": _resize_once(
+                    model, tx, cross_entropy_loss, config, client,
+                    devices, target, forced_orbax=True),
+            }
+        headline = scenarios["shrink"]["replan"][
+            "time_to_first_step_s"]
+        snap = master.goodput_ledger.snapshot()
+        return {
+            "metric": "replan_time_to_first_step_seconds",
+            "value": headline,
+            "unit": (f"s (join -> plan -> migrate -> rebuild -> first "
+                     f"step; {BASE_DEVICES}->{BASE_DEVICES - 1} chips, "
+                     f"batch {GLOBAL_BATCH})"),
+            "base_devices": BASE_DEVICES,
+            "base_step": base_step,
+            "scenarios": scenarios,
+            "replans_priced": snap.get("replans", []),
+            "goodput_fraction": snap.get("goodput_fraction", 0.0),
+            "workdir": workdir,
+        }
+    finally:
+        client.close()
+        master.stop()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("bench_replan", description=__doc__)
+    parser.parse_args()
+    result = run_bench()
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    _ensure_cpu_devices(BASE_DEVICES + 1)
+    raise SystemExit(main())

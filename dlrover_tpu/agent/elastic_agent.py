@@ -253,6 +253,11 @@ class ElasticAgent:
         self.peer_cache_dir = os.path.join(self._workdir, "peer_cache")
         self.restore_plan_file = os.path.join(self._workdir,
                                               "restore_plan.json")
+        # online parallelism re-plan from the join result
+        # (parallel/planner.py): the spawned worker builds its mesh +
+        # batch shape from this file (or re-fetches fresh via RPC)
+        self.shard_plan_file = os.path.join(self._workdir,
+                                            "shard_plan.json")
         self._peer_donor = None
         # (ino, mtime_ns, size) of the manifest at the last report —
         # the same stat-key dedup contract as the drain channel, so the
@@ -356,6 +361,7 @@ class ElasticAgent:
             NodeEnv.DRAIN_REQUEST_FILE: self.drain_request_file,
             NodeEnv.PEER_CACHE_DIR: self.peer_cache_dir,
             NodeEnv.RESTORE_PLAN_FILE: self.restore_plan_file,
+            NodeEnv.SHARD_PLAN_FILE: self.shard_plan_file,
             # the worker sees the same notice path the agent polls, so
             # the chaos `preempt` fault (running in the worker's step
             # loop) can deliver a notice to THIS agent deterministically
@@ -755,6 +761,17 @@ class ElasticAgent:
             os.replace(tmp, self.restore_plan_file)
         except OSError:
             logger.warning("could not publish the restore plan file")
+        # the parallelism plan rides the same join result: the mesh +
+        # batch shape the new world agreed on (parallel/planner.py)
+        shard_payload = getattr(self._client, "last_shard_plan_json",
+                                "") or "{}"
+        tmp = f"{self.shard_plan_file}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(shard_payload)
+            os.replace(tmp, self.shard_plan_file)
+        except OSError:
+            logger.warning("could not publish the shard plan file")
 
     # -- preemption drain --------------------------------------------------
     def _start_preemption_watcher(self) -> None:

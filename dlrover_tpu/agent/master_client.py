@@ -93,6 +93,7 @@ class MasterClient:
         # the peer-restore plan the last join result carried ("" = none):
         # the agent publishes it to the worker via the plan file
         self.last_restore_plan_json = ""
+        self.last_shard_plan_json = ""
         # owned by the CLIENT, not the stub: a seeded chaos injector
         # must keep its RNG sequence across reconnect()s, or a seed
         # whose first draw fires would deterministically kill the first
@@ -225,6 +226,8 @@ class MasterClient:
             self.master_generation = result.generation
         self.last_restore_plan_json = getattr(result,
                                               "restore_plan_json", "")
+        self.last_shard_plan_json = getattr(result,
+                                            "shard_plan_json", "")
         return result.round
 
     def reconnect_report(self, local_world_size: int = 1,
@@ -313,14 +316,35 @@ class MasterClient:
         return status if isinstance(status, dict) else {}
 
     @retry_rpc(retries=3)
-    def get_restore_plan(self, rdzv_name: str = RendezvousName.TRAINING
-                         ) -> dict:
-        """A fresh peer-restore plan for this rank ({} = no donors)."""
+    def get_shard_plan(self, rdzv_name: str = RendezvousName.TRAINING
+                       ) -> dict:
+        """The current parallelism plan for this rank's world
+        (parallel/planner.py; {} = no plan / master predates it)."""
+        import json
+
+        result = self._get_typed(msg.ShardPlanRequest(
+            node_id=self.node_id, node_rank=self.node_rank,
+            rdzv_name=rdzv_name), msg.ShardPlanResult)
+        if not result.found or not result.plan_json:
+            return {}
+        try:
+            plan = json.loads(result.plan_json)
+        except json.JSONDecodeError:
+            return {}
+        return plan if isinstance(plan, dict) else {}
+
+    @retry_rpc(retries=3)
+    def get_restore_plan(self, rdzv_name: str = RendezvousName.TRAINING,
+                         stripe: bool = False) -> dict:
+        """A fresh peer-restore plan for this rank ({} = no donors).
+        ``stripe``: the resharding-migration mode — entries list every
+        same-step holder so the receiver fetches byte ranges of one
+        shard from several donors in parallel."""
         import json
 
         result = self._get_typed(msg.RestorePlanRequest(
             node_id=self.node_id, node_rank=self.node_rank,
-            rdzv_name=rdzv_name), msg.RestorePlan)
+            rdzv_name=rdzv_name, stripe=stripe), msg.RestorePlan)
         if not result.found or not result.plan_json:
             return {}
         try:
@@ -470,16 +494,23 @@ class MasterClient:
                           flops_per_token: float = 0.0,
                           peak_flops_per_chip: float = 0.0,
                           chips: int = 0,
-                          flops_source: str = "") -> bool:
+                          flops_source: str = "",
+                          tensor_divisor: int = 0,
+                          fsdp_divisor: int = 0,
+                          effective_global_batch: int = 0) -> bool:
         """Static model stats for the resource optimizer (reference:
         profile_extractor reporting ModelInfo) plus the FLOPs model
-        that turns the master's tokens/s series into MFU gauges."""
+        that turns the master's tokens/s series into MFU gauges and
+        the dim-divisibility granules the parallelism planner filters
+        tensor/fsdp candidates by (parallel/planner.py)."""
         return self._report(msg.ModelInfo(
             param_count=param_count, param_bytes=param_bytes,
             flops_per_step=flops_per_step, batch_size=batch_size,
             seq_len=seq_len, flops_per_token=flops_per_token,
             peak_flops_per_chip=peak_flops_per_chip, chips=chips,
-            flops_source=flops_source,
+            flops_source=flops_source, tensor_divisor=tensor_divisor,
+            fsdp_divisor=fsdp_divisor,
+            effective_global_batch=effective_global_batch,
         )).success
 
     def get_goodput(self, window_s: float = 0.0) -> dict:

@@ -362,8 +362,26 @@ class _DonorHandler(socketserver.StreamRequestHandler):
             return self._reply({"ok": False,
                                 "error": f"shard {key!r} unavailable"})
         meta = manifest["shards"][key]
+        # byte-range serving (the resharding-migration stripe mode,
+        # master/rendezvous.py compute_restore_plan(stripe=True)): the
+        # receiver reassembles ranges from several donors and verifies
+        # the FULL-shard CRC carried in every range header. The whole
+        # shard was CRC-verified by read_local_shard above, so a range
+        # of it is trustworthy too.
+        offset = int(request.get("offset", 0) or 0)
+        length = request.get("length")
+        if offset or length is not None:
+            end = (offset + int(length)) if length is not None \
+                else len(data)
+            if not (0 <= offset <= end <= len(data)):
+                return self._reply({
+                    "ok": False,
+                    "error": f"bad range [{offset}, {end}) of "
+                             f"{len(data)}"})
+            data = data[offset:end]
         return self._reply({"ok": True, "nbytes": len(data),
                             "crc32": meta["crc32"],
+                            "total_nbytes": meta["nbytes"],
                             "dtype": meta["dtype"],
                             "shape": meta["shape"]}, data)
 
@@ -495,6 +513,25 @@ def _verify(data: bytes, header: Dict[str, Any],
             == int(header.get("crc32", -1)))
 
 
+def _stripe_ranges(nbytes: int, parts: int) -> List[Tuple[int, int]]:
+    """Split ``nbytes`` into ``parts`` contiguous (offset, length)
+    ranges — the byte-level "who sends which shard slice" of the
+    resharding-migration stripe mode. Deterministic, covers every byte
+    exactly once, tolerates parts > nbytes (empty tail ranges are
+    dropped)."""
+    parts = max(1, min(parts, nbytes)) if nbytes > 0 else 1
+    base, extra = divmod(nbytes, parts)
+    ranges: List[Tuple[int, int]] = []
+    offset = 0
+    for i in range(parts):
+        length = base + (1 if i < extra else 0)
+        if length <= 0:
+            continue
+        ranges.append((offset, length))
+        offset += length
+    return ranges
+
+
 def fetch_shards(
     plan: Dict[str, Any],
     wanted: Dict[str, int],
@@ -508,12 +545,24 @@ def fetch_shards(
     its own host) never touch the network. Returns (key → bytes,
     per-donor byte table — "local" for cache hits, missing keys). A
     failed/timed-out/corrupt shard is simply missing: the caller decides
-    between the shard-wise Orbax fallback and a wholesale one."""
+    between the shard-wise Orbax fallback and a wholesale one.
+
+    Striped entries (plan mode "stripe": ``{"ranks": [...], "addrs":
+    [...]}``) split the shard's bytes into contiguous ranges fetched
+    from several donors in parallel — the resharding migration's
+    transfer primitive. The reassembled shard is verified against the
+    FULL-shard CRC every range header carries; any failed range fails
+    the whole key (missing, never wrong)."""
     step = int(plan.get("step", -1))
     entries = plan.get("entries", {})
     got: Dict[str, bytes] = {}
     donor_bytes: Dict[str, int] = {}
-    remote: Dict[str, List[str]] = {}   # addr -> keys
+    # addr -> [(key, offset, length or None=whole)]
+    remote: Dict[str, List[Tuple[str, int, Optional[int]]]] = {}
+    # striped reassembly state: key -> {offset: bytes}, key -> crc set
+    striped_parts: Dict[str, Dict[int, bytes]] = {}
+    striped_crcs: Dict[str, set] = {}
+    striped_expected: Dict[str, int] = {}   # number of ranges issued
     missing: List[str] = []
     local_manifest = (load_stage_manifest(local_cache_dir, step)
                       if local_cache_dir else None)
@@ -526,26 +575,48 @@ def fetch_shards(
                 donor_bytes["local"] = (donor_bytes.get("local", 0)
                                         + len(data))
                 continue
-        if not entry or not entry.get("addr"):
+        if not entry:
             missing.append(key)
             continue
-        remote.setdefault(entry["addr"], []).append(key)
+        addrs = entry.get("addrs") or []
+        if len(addrs) > 1 and nbytes > 0:
+            ranges = _stripe_ranges(nbytes, len(addrs))
+            striped_expected[key] = len(ranges)
+            striped_parts[key] = {}
+            striped_crcs[key] = set()
+            for addr, (offset, length) in zip(addrs, ranges):
+                remote.setdefault(addr, []).append((key, offset,
+                                                    length))
+            continue
+        addr = entry.get("addr") or (addrs[0] if addrs else "")
+        if not addr:
+            missing.append(key)
+            continue
+        remote.setdefault(addr, []).append((key, 0, None))
+
+    # collected under `lock` by the per-donor threads
+    lock = threading.Lock()
+    failed_keys: set = set()
 
     def _fetch_from(addr: str) -> Tuple[Dict[str, bytes], List[str]]:
         fetched: Dict[str, bytes] = {}
         failed: List[str] = []
+        work = remote[addr]
         conn = None
+        done: List[Tuple] = []
         try:
             conn = _DonorConnection(addr, timeout_s=30.0)
-            for key in remote[addr]:
+            for item in work:
+                key, offset, length = item
                 if deadline and time.time() > deadline:
-                    failed.extend(k for k in remote[addr]
-                                  if k not in fetched and k not in failed)
                     break
+                request = {"op": "shard", "key": key, "step": step}
+                if length is not None:
+                    request["offset"] = offset
+                    request["length"] = length
                 try:
-                    header, data = conn.request(
-                        {"op": "shard", "key": key, "step": step},
-                        deadline=deadline)
+                    header, data = conn.request(request,
+                                                deadline=deadline)
                 except (OSError, ValueError):
                     # connection died mid-stream: re-dial once for the
                     # remaining keys of this donor (unless the budget
@@ -554,21 +625,44 @@ def fetch_shards(
                         raise
                     conn.close()
                     conn = _DonorConnection(addr, timeout_s=30.0)
-                    header, data = conn.request(
-                        {"op": "shard", "key": key, "step": step},
-                        deadline=deadline)
-                if header.get("ok") and _verify(data, header,
-                                                wanted[key]):
-                    fetched[key] = data
+                    header, data = conn.request(request,
+                                                deadline=deadline)
+                done.append(item)
+                if length is None:
+                    if header.get("ok") and _verify(data, header,
+                                                    wanted[key]):
+                        fetched[key] = data
+                    else:
+                        failed.append(key)
+                    continue
+                # striped range: stash the part; the reassembly (and
+                # the full-shard CRC check) happens once every donor
+                # thread finished
+                if header.get("ok") and len(data) == length:
+                    with lock:
+                        striped_parts[key][offset] = data
+                        striped_crcs[key].add(
+                            int(header.get("crc32", -1)))
                 else:
-                    failed.append(key)
+                    with lock:
+                        failed_keys.add(key)
         except (OSError, ValueError) as e:
             logger.warning("peer fetch from %s failed: %s", addr, e)
-            failed.extend(k for k in remote[addr]
-                          if k not in fetched and k not in failed)
         finally:
             if conn is not None:
                 conn.close()
+        # anything not completed on this donor: whole keys fail here,
+        # striped keys fail via failed_keys
+        for item in work:
+            if item in done:
+                continue
+            key, offset, length = item
+            if length is None:
+                if key not in fetched and key not in failed:
+                    failed.append(key)
+            else:
+                with lock:
+                    failed_keys.add(key)
         return fetched, failed
 
     if remote:
@@ -577,8 +671,34 @@ def fetch_shards(
             for addr, (fetched, failed) in zip(
                     remote, pool.map(_fetch_from, list(remote))):
                 got.update(fetched)
-                donor_bytes[addr] = sum(len(d) for d in fetched.values())
+                if fetched:
+                    donor_bytes[addr] = sum(len(d)
+                                            for d in fetched.values())
                 missing.extend(failed)
+    # striped reassembly: every range present, the donors' full-shard
+    # CRCs agree, and the assembled bytes re-hash to that CRC — a
+    # failed/disagreeing stripe makes the key MISSING, never wrong
+    for key, parts in striped_parts.items():
+        nbytes = wanted[key]
+        crcs = striped_crcs.get(key) or set()
+        if (key in failed_keys
+                or len(parts) != striped_expected.get(key, -1)
+                or len(crcs) != 1):
+            missing.append(key)
+            continue
+        assembled = b"".join(parts[off] for off in sorted(parts))
+        expected_crc = next(iter(crcs))
+        if (len(assembled) != nbytes
+                or (zlib.crc32(assembled) & 0xFFFFFFFF) != expected_crc):
+            missing.append(key)
+            continue
+        got[key] = assembled
+        for addr, work in remote.items():
+            contributed = sum(length or 0 for k, off, length in work
+                              if k == key and off in parts)
+            if contributed:
+                donor_bytes[addr] = (donor_bytes.get(addr, 0)
+                                     + contributed)
     return got, donor_bytes, missing
 
 
@@ -598,6 +718,10 @@ class PeerRestorer:
         self._plan_file = (plan_file
                            or os.environ.get(NodeEnv.RESTORE_PLAN_FILE,
                                              ""))
+        # resharding-migration mode (set by the loop when a parallelism
+        # re-plan changed the target sharding): RPC plans stripe each
+        # shard's byte ranges across every same-step holder
+        self.stripe = False
 
     @classmethod
     def from_env(cls, client=None) -> Optional["PeerRestorer"]:
@@ -620,7 +744,11 @@ class PeerRestorer:
         no master — a purely local pseudo-plan over this host's cache."""
         if self._client is not None:
             try:
-                plan = self._client.get_restore_plan()
+                # stripe passed only when armed: client wrappers/shims
+                # predating the kwarg keep working on the default path
+                plan = (self._client.get_restore_plan(stripe=True)
+                        if self.stripe
+                        else self._client.get_restore_plan())
                 if plan:
                     return plan
             except Exception:  # noqa: BLE001 — degrade to the file plan

@@ -132,6 +132,11 @@ class Context:
             DefaultValues.PEER_RESTORE_TIMEOUT_S
         )
         self.peer_donor_port: int = DefaultValues.PEER_DONOR_PORT
+        # online parallelism re-planning (parallel/planner.py): the
+        # worker builds its mesh + batch/accumulation shape from the
+        # master's shard plan; False pins the configured mesh (resizes
+        # then only re-form the same DP shape — pre-PR-9 behavior)
+        self.replan_enabled: bool = DefaultValues.REPLAN_ENABLED
         # multi-slice hierarchical DP (parallel/dcn_sync.py): degraded-
         # mode budget while a slice is absent, the per-step DCN collect
         # deadline, and the wire quantization of the host-level sync

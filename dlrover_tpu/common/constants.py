@@ -173,6 +173,18 @@ class NodeEnv:
     # restore plan the agent received in its join result (JSON file);
     # workers with a master client re-fetch a fresh plan via RPC instead
     RESTORE_PLAN_FILE = "DLROVER_TPU_RESTORE_PLAN"
+    # parallelism plan for the new world (parallel/planner.py), written
+    # by the agent from its join result; workers with a master client
+    # re-fetch fresh via ShardPlanRequest at loop build
+    SHARD_PLAN_FILE = "DLROVER_TPU_SHARD_PLAN"
+    # chaos `resize:+k@step` handoff: the injector atomically writes
+    # the scale-up request here; the LAUNCHER (bench/test harness,
+    # operator) consumes it and starts k more agents — adding ranks
+    # needs a process spawner, which lives outside the worker
+    RESIZE_REQUEST_FILE = "DLROVER_TPU_RESIZE_REQUEST"
+    # total ICI slices of the job (slice-unit chaos resize targets the
+    # k highest slice ids; unset = slice-unit resize faults disabled)
+    NUM_SLICES = "DLROVER_TPU_NUM_SLICES"
     # platform/chaos → agent: a preemption-notice file the agent's
     # PreemptionWatcher polls ({"deadline": ts} or {"grace_s": n})
     PREEMPTION_NOTICE_FILE = "DLROVER_TPU_PREEMPTION_NOTICE"
@@ -332,6 +344,11 @@ class DefaultValues:
     # donor server port (0 = ephemeral; the advertised addr rides the
     # PeerStoreReport RPC either way)
     PEER_DONOR_PORT = 0
+    # -- online parallelism re-planning (parallel/planner.py) -----------
+    # apply the master's shard plan when building the worker's mesh
+    # (mesh spec + batch/accumulation override); False pins the
+    # configured mesh — resizes then only re-form the same DP shape
+    REPLAN_ENABLED = True
     # -- step-hang watchdog (trainer/watchdog.py) -----------------------
     # no step progress for this long → dump all-thread stacks + the
     # flight record and self-abort so the agent restarts the worker.

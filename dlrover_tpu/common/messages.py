@@ -201,6 +201,13 @@ class JoinRendezvousResult(Message):
     # RestorePlanRequest anyway — this copy serves workers with no master
     # client and records the plan at the re-rendezvous cut.
     restore_plan_json: str = ""
+    # Online parallelism re-plan for the joining world
+    # (parallel/planner.py): the deterministic DP×TP×PP(×DCN) mesh +
+    # batch/accumulation shape chosen for the NEW world size, stamped
+    # with the rendezvous generation token and world epoch. "" = no
+    # planner input yet / sender predates the field; workers re-fetch
+    # fresh via ShardPlanRequest at loop build.
+    shard_plan_json: str = ""
 
 
 @dataclass
@@ -298,6 +305,11 @@ class RestorePlanRequest(Message):
     node_rank: int = -1
     rdzv_name: str = ""
     epoch_only: bool = False
+    # resharding mode (online re-plan migration): entries list EVERY
+    # same-step holder of each shard so the receiver stripes byte
+    # ranges across donors in parallel — who sends which shard SLICE to
+    # whom when the target sharding differs from the source
+    stripe: bool = False
 
 
 @dataclass
@@ -307,6 +319,29 @@ class RestorePlan(Message):
     # loss): a plan whose epoch no longer matches must not commit
     epoch: int = 0
     step: int = -1
+    found: bool = False
+
+
+@dataclass
+class ShardPlanRequest(Message):
+    """A worker (or tool) asking for the current parallelism plan for
+    its world (parallel/planner.py via the rendezvous manager): the
+    deterministic mesh + batch shape every rank of the new world must
+    agree on. The plan is recomputed from live membership, so a worker
+    spawned after the cut sees the cut world's plan."""
+
+    node_id: int = -1
+    node_rank: int = -1
+    rdzv_name: str = ""
+
+
+@dataclass
+class ShardPlanResult(Message):
+    plan_json: str = ""          # JSON plan dict ("" = no plan)
+    # world epoch the plan was computed at (same staleness discipline
+    # as RestorePlan: a membership loss after computation bumps it)
+    epoch: int = 0
+    generation: int = 0
     found: bool = False
 
 
@@ -502,8 +537,14 @@ class ModelInfo(Message):
     param_count: int = 0
     param_bytes: int = 0
     flops_per_step: float = 0.0
+    # the CONFIGURED global batch (the planner's requested baseline: a
+    # re-plan that shrank the batch must not ratchet the profile down
+    # — a later grow should restore the full batch)
     batch_size: int = 0
     seq_len: int = 0
+    # the batch actually trained per step right now (re-plan adjusted;
+    # 0 = same as batch_size) — what tokens/s gauges scale by
+    effective_global_batch: int = 0
     # model-FLOPs accounting (obs/mfu.py): FLOPs per trained token
     # (fwd+bwd, causal-discounted attention term), the sender's per-chip
     # bf16 peak, and the global chip count its mesh spans — the master's
@@ -515,6 +556,14 @@ class ModelInfo(Message):
     # "analytic" (6·params formula) or "cost_analysis" (cross-checked
     # against the compiled step's XLA cost analysis)
     flops_source: str = ""
+    # model-dim divisibility granules for the parallelism planner
+    # (parallel/planner.py): a tensor axis is only feasible when it
+    # divides tensor_divisor (gcd of heads/kv-heads/mlp/vocab dims),
+    # an fsdp axis when it divides fsdp_divisor (the embed dim). 0 =
+    # unknown — the planner then relies on the worker-side trace probe
+    # + loud fallback.
+    tensor_divisor: int = 0
+    fsdp_divisor: int = 0
 
 
 # --------------------------------------------------------------------------

@@ -52,9 +52,37 @@ Spec grammar (semicolon-separated faults):
                            the slice drains AS A UNIT — notice RPC,
                            slice-wide drain fan-out, emergency saves,
                            one-round re-formation of the survivors
+    resize:-2@10           the 2 HIGHEST-ranked workers leave the world
+                           at step 10 with the clean-drain exit (the
+                           deterministic scale-DOWN: the master removes
+                           them as planned departures, survivors
+                           re-plan the parallelism for the smaller
+                           world and re-form in one round). The rank
+                           field carries the signed delta. Multi-slice
+                           jobs must set $DLROVER_TPU_NODE_NUM (fleet
+                           rank count): WORLD_SIZE is slice-local there
+                           while node ranks are fleet-global.
+    resize:+2@10           worker rank 0 atomically writes a scale-UP
+                           request ({"delta": 2, ...}) to
+                           $DLROVER_TPU_RESIZE_REQUEST at step 10; the
+                           LAUNCHER (bench/test harness, operator)
+                           consumes it and starts 2 more agents —
+                           adding ranks needs a process spawner, which
+                           lives outside the worker by construction
+    resize:slice:-1@10     slice-unit scale-down: every rank whose
+                           slice id is among the $DLROVER_TPU_NUM_SLICES
+                           highest leaves with the clean-drain exit at
+                           step 10 (requires NUM_SLICES in the env;
+                           resize:slice:+k writes the request file with
+                           unit="slice")
 
-Each kill/hang/preempt fault fires at most once per process; slow
-applies from its step onward. The hook is a no-op (one env read at construction)
+Each kill/hang/preempt/resize fault fires at most once per process;
+slow applies from its step onward. Resize faults additionally record a
+JOB-wide consumed marker (with CHAOS_STATE_ENV set) the moment the
+step fires: the departing set is decided against the world at fire
+time, so a survivor respawned into the post-resize world never
+re-evaluates the delta against the smaller world and cascades the
+drain. The hook is a no-op (one env read at construction)
 when the variable is unset — zero cost on the training path.
 
 One-shot markers (CHAOS_STATE_ENV) are keyed by the fault's INDEX in
@@ -69,6 +97,7 @@ DLROVER_TPU_CHAOS_NET — lives in common/comm.py.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import signal
 import time
@@ -86,10 +115,13 @@ CHAOS_STATE_ENV = "DLROVER_TPU_CHAOS_STATE"
 
 @dataclasses.dataclass
 class ChaosFault:
-    action: str            # "kill" | "hang" | "slow" | "preempt"
+    action: str            # "kill" | "hang" | "slow" | "preempt" |
+    #                        "resize"
     role: str              # node type the fault targets ("worker",
-    #                        "master", …)
-    rank: int              # node rank within the role
+    #                        "master", …); the resize UNIT ("worker" |
+    #                        "slice") for resize faults
+    rank: int              # node rank within the role; the SIGNED
+    #                        delta for resize faults
     at_step: int           # fire when the target reaches this step
     # hang: block seconds; slow: sleep/step; preempt: grace window
     # (<= 0 → Context.preempt_default_grace_s)
@@ -109,8 +141,29 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
             filter(None, (p.strip() for p in spec.split(";")))):
         try:
             head, at = part.split("@", 1)
-            action, role, rank = head.split(":")
+            head_fields = head.split(":")
             at_fields = at.split(":")
+            if head_fields[0].strip().lower() == "resize":
+                # resize:±k@step (ranks) / resize:slice:±k@step
+                # (slices): the "rank" field carries the SIGNED delta
+                if len(head_fields) == 2:
+                    role, delta = "worker", head_fields[1]
+                else:
+                    role, delta = head_fields[1], head_fields[2]
+                delta_n = int(delta)
+                if delta_n == 0:
+                    raise ValueError("resize delta must be non-zero")
+                fault = ChaosFault(
+                    action="resize", role=role.strip(),
+                    rank=delta_n, at_step=int(at_fields[0]),
+                    index=index)
+                if fault.role not in ("worker", "slice"):
+                    raise ValueError(
+                        f"resize unit must be worker or slice, "
+                        f"got {fault.role!r}")
+                faults.append(fault)
+                continue
+            action, role, rank = head_fields
             fault = ChaosFault(
                 action=action.strip().lower(), role=role.strip(),
                 rank=int(rank), at_step=int(at_fields[0]),
@@ -121,7 +174,8 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
         except (ValueError, IndexError) as e:
             raise ValueError(
                 f"bad chaos fault {part!r} (want "
-                f"'action:role:rank@step[:duration]'): {e}") from e
+                f"'action:role:rank@step[:duration]' or "
+                f"'resize:[slice:]±k@step'): {e}") from e
         if fault.action not in ("kill", "hang", "slow", "preempt"):
             raise ValueError(f"unknown chaos action {fault.action!r}")
         if fault.action == "preempt" and len(at_fields) == 1:
@@ -154,11 +208,15 @@ class ChaosInjector:
         self._state_dir = os.environ.get(CHAOS_STATE_ENV, "")
         # a "slice"-role fault addresses the SLICE in its rank field:
         # every member of that slice arms it, so kill/preempt fan
-        # across the whole failure domain
+        # across the whole failure domain. Resize faults arm on EVERY
+        # worker — whether this rank is part of the delta is decided at
+        # fire time against the live world/slice count.
         self.faults = [
             f for f in parse_chaos(spec)
-            if (f.role == role and f.rank == rank)
-            or (f.role == "slice" and role == "worker"
+            if (f.action == "resize" and role == "worker")
+            or (f.role == role and f.rank == rank)
+            or (f.role == "slice" and f.action != "resize"
+                and role == "worker"
                 and slice_id >= 0 and f.rank == slice_id)
         ] if spec else []
         for fault in self.faults:
@@ -171,19 +229,78 @@ class ChaosInjector:
     def _marker(self, fault: ChaosFault) -> str:
         # keyed by spec index: two faults that agree on
         # action/role/rank/step still get their own markers. A
-        # slice-role fault additionally keys on THIS node's rank —
-        # every member of the slice must fire its own copy (one shared
-        # marker would let the first member claim the whole slice's
-        # fault and leave the rest alive).
-        per_node = f"_n{self._rank}" if fault.role == "slice" else ""
+        # slice-role or resize fault additionally keys on THIS node's
+        # rank — every affected member must fire its own copy (one
+        # shared marker would let the first member claim the whole
+        # unit's fault and leave the rest alive).
+        per_node = (f"_n{self._rank}"
+                    if fault.role == "slice" or fault.action == "resize"
+                    else "")
         return os.path.join(
             self._state_dir,
             f"chaos_{fault.index}_{fault.action}_{fault.role}"
             f"_{fault.rank}_{fault.at_step}{per_node}")
 
+    def _job_marker(self, fault: ChaosFault) -> str:
+        """Resize faults additionally keep a JOB-wide marker recording
+        the world (or slice count) at fire time: the departing set is
+        decided against THAT world — a survivor respawned into the
+        post-resize world must not re-evaluate the delta against the
+        new (smaller) world and cascade the drain, while a LEAVING
+        rank respawned before it reached ``at_step`` must still fire
+        (suppressing it would remove fewer than k ranks)."""
+        return self._marker(fault).replace(f"_n{self._rank}", "_job")
+
+    def _job_fire_world(self, fault: ChaosFault) -> Optional[int]:
+        """The world size recorded when the resize fault first fired
+        anywhere in the job; None = not fired yet (or no state dir)."""
+        if not self._state_dir:
+            return None
+        try:
+            with open(self._job_marker(fault)) as f:
+                payload = json.loads(f.read() or "{}")
+            return int(payload.get("world", 0)) or 0
+        except (OSError, ValueError):
+            return None
+
+    def _record_job_fired(self, fault: ChaosFault, world: int) -> None:
+        """First claimer records the fire-time world (O_EXCL: exactly
+        one writer; later rank/incarnations read it back)."""
+        if not self._state_dir:
+            return
+        try:
+            os.makedirs(self._state_dir, exist_ok=True)
+            fd = os.open(self._job_marker(fault),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except OSError:
+            return
+        with os.fdopen(fd, "w") as f:
+            json.dump({"world": int(world), "pid": os.getpid()}, f)
+
+    def _resize_leaving(self, fault: ChaosFault, world: int) -> bool:
+        """Is THIS process in the departing set of a scale-down fault
+        judged against ``world`` (ranks or slices)?"""
+        member = self._slice if fault.role == "slice" else self._rank
+        return member >= world + fault.rank
+
     def _already_fired(self, fault: ChaosFault) -> bool:
-        return bool(self._state_dir) and os.path.exists(
-            self._marker(fault))
+        if not self._state_dir:
+            return False
+        if os.path.exists(self._marker(fault)):
+            return True
+        if fault.action != "resize":
+            return False
+        fired_world = self._job_fire_world(fault)
+        if fired_world is None:
+            return False
+        if fault.rank > 0 or not fired_world:
+            # scale-up (single writer) — or a marker predating the
+            # world payload: conservatively consumed
+            return True
+        # scale-down: consumed for survivors of the FIRE-TIME world;
+        # a leaver that respawned before reaching at_step must still
+        # fire (its own per-node marker records its actual exit)
+        return not self._resize_leaving(fault, fired_world)
 
     def _record_fired(self, fault: ChaosFault) -> bool:
         """Claim the one-shot marker; returns whether THIS process won.
@@ -230,9 +347,80 @@ class ChaosInjector:
                 if not self._record_fired(fault):
                     continue
                 self._write_preemption_notice(fault, step)
+            elif fault.action == "resize":
+                self._inject_resize(fault, step)
             elif fault.action == "slow":
                 # applies every step from at_step on (a real straggler)
                 time.sleep(fault.duration)
+
+    def _inject_resize(self, fault: ChaosFault, step: int) -> None:
+        """Deterministic mid-run resize. Scale-DOWN (delta < 0): this
+        process leaves with the clean-drain exit when its rank (or
+        slice) is among the |delta| highest — the agent concludes a
+        planned departure, the master removes the rank immediately and
+        survivors re-plan + re-form in ONE round. Scale-UP (delta > 0):
+        rank 0 atomically writes the resize-request file the LAUNCHER
+        polls (spawning processes is the launcher's power, not the
+        worker's)."""
+        from dlrover_tpu.common.constants import NodeEnv, WorkerExit
+
+        delta = fault.rank
+        if delta < 0:
+            # the departing set is judged against the world at FIRST
+            # fire: the job marker's recorded size wins over the env —
+            # a respawn into the already-shrunken world must neither
+            # cascade (survivor re-draining) nor under-deliver (a
+            # leaver that had not reached at_step yet)
+            if fault.role == "slice":
+                world_env = NodeEnv.NUM_SLICES
+            elif self._slice >= 0:
+                # multi-slice job: WORLD_SIZE is the SLICE-LOCAL comm
+                # world (per-slice worlds, PR 8) while node ranks are
+                # fleet-global — a worker-unit delta needs the fleet
+                # rank count or the wrong ranks drain
+                world_env = NodeEnv.NODE_NUM
+            else:
+                world_env = NodeEnv.WORLD_SIZE
+            world = (self._job_fire_world(fault)
+                     or int(os.environ.get(world_env, "0")))
+            if world <= 0:
+                logger.warning(
+                    "chaos resize:%s%d needs %s in the env; skipping",
+                    "slice:" if fault.role == "slice" else "", delta,
+                    world_env)
+                fault.fired = True
+                return
+            self._record_job_fired(fault, world)
+            leaving = self._resize_leaving(fault, world)
+            fault.fired = True
+            if not leaving:
+                return
+            if not self._record_fired(fault):
+                return
+            logger.warning(
+                "chaos: resize %+d at step %d — %s-%d leaves with the "
+                "clean-drain exit (survivors re-plan the smaller "
+                "world)", delta, step, self._role, self._rank)
+            raise SystemExit(WorkerExit.DRAIN)
+        # scale-up: one writer (rank 0) hands the request to the
+        # launcher; everyone else just marks the fault consumed
+        fault.fired = True
+        if self._rank != 0:
+            return
+        if not self._record_fired(fault):
+            return
+        path = os.environ.get(NodeEnv.RESIZE_REQUEST_FILE, "")
+        logger.warning(
+            "chaos: resize %+d (%ss) requested at step %d -> %s",
+            delta, fault.role, step, path or "<no request file>")
+        if not path:
+            return
+        payload = {"delta": delta, "unit": fault.role, "step": step,
+                   "ts": time.time()}
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
 
     def _write_preemption_notice(self, fault: ChaosFault,
                                  step: int) -> None:

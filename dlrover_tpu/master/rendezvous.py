@@ -104,6 +104,30 @@ class RendezvousManager:
         # failure mid-transfer may have taken the donor (or made the
         # planned world itself stale)
         self._world_epoch = 0
+        # -- online parallelism re-planning (parallel/planner.py) ------
+        # model profile fields fed from ModelInfo reports + chip-stats
+        # HBM totals: what the planner scores candidates against. Empty
+        # until the first worker reports — plans computed before that
+        # rank on topology alone (still deterministic).
+        self._model_profile: Dict[str, float] = {}
+        self._chip_hbm_bytes: int = 0
+        # the last stamped plan (fleet-wide — in slice mode the plan
+        # spans every formed slice with dcn = slice count): its mesh
+        # feeds the migration term of the NEXT plan, and a change
+        # against it is what counts as a REAL re-plan. The inputs it
+        # was computed from memoize the planner: every join and every
+        # worker's ShardPlanRequest asks, and re-enumerating the mesh
+        # space under the manager lock for identical inputs would
+        # serialize liveness-critical RPCs behind pure recomputation.
+        self._last_plan: Optional[Dict] = None
+        self._last_plan_inputs: Optional[Tuple] = None
+        # rank -> chips, remembered across world invalidations: the
+        # planner must see the EXPECTED post-re-formation world at the
+        # FIRST survivor's join (cut worlds are emptied on a death and
+        # the waiting list fills one join at a time — planning only
+        # from those would stamp a transient partial-world plan per
+        # join and fire N-1 spurious re-plan events)
+        self._known_chips: Dict[int, int] = {}
         # -- slice-scoped failure domains ------------------------------
         # rank -> slice id, learned from joins/peer-store reports; any
         # entry (with slice_scoped) switches the manager to per-slice
@@ -383,7 +407,151 @@ class RendezvousManager:
         with self._lock:
             return {rank: dict(s) for rank, s in self._peer_stores.items()}
 
-    def compute_restore_plan(self, node_rank: int) -> Dict:
+    # -- online parallelism re-planning (parallel/planner.py) --------------
+    def set_model_profile(self, param_count: int = 0,
+                          param_bytes: int = 0,
+                          flops_per_token: float = 0.0,
+                          peak_flops_per_chip: float = 0.0,
+                          seq_len: int = 0,
+                          global_batch: int = 0,
+                          tensor_divisor: int = 0,
+                          fsdp_divisor: int = 0) -> None:
+        """Teach the planner the model's shape (fed from ModelInfo
+        reports by the servicer). Zero fields leave the previous value
+        standing — a cross-check re-report that only updates the FLOPs
+        model must not erase the batch."""
+        updates = {"param_count": param_count, "param_bytes": param_bytes,
+                   "flops_per_token": flops_per_token,
+                   "peak_flops_per_chip": peak_flops_per_chip,
+                   "seq_len": seq_len, "global_batch": global_batch,
+                   "tensor_divisor": tensor_divisor,
+                   "fsdp_divisor": fsdp_divisor}
+        with self._lock:
+            for key, value in updates.items():
+                if value and value > 0:
+                    if self._model_profile.get(key) != value:
+                        self._model_profile[key] = value
+                        self._mutations += 1
+
+    def set_chip_hbm(self, hbm_bytes: int) -> None:
+        """Observed per-chip HBM total (from NodeResourceStats chip
+        stats): the planner's memory-fit budget. 0 stays 0 —
+        unconstrained (CPU harnesses)."""
+        with self._lock:
+            if hbm_bytes > 0 and self._chip_hbm_bytes != int(hbm_bytes):
+                self._chip_hbm_bytes = int(hbm_bytes)
+                self._mutations += 1
+
+    def _plan_world_locked(self) -> Dict[int, int]:
+        """(lock held) The world the next plan must cover: every alive,
+        non-draining rank — cut worlds and the waiting list give the
+        freshest chip counts, the remembered ``_known_chips`` covers
+        survivors that have not re-joined yet (their world was
+        invalidated an instant ago, but they ARE part of the world
+        that is about to form). Planning from the full expected set
+        means the FIRST join after a membership change already sees
+        the final plan — one re-plan per resize, not one per joiner."""
+        chips: Dict[int, int] = dict(self._known_chips)
+        if self._slice_mode_locked():
+            for world in self._slice_worlds.values():
+                chips.update(world)
+        else:
+            chips.update(self._latest_world)
+        for rank, waiting in self._waiting.items():
+            chips[rank] = waiting.local_world_size
+        return {rank: int(n) for rank, n in chips.items()
+                if rank in self._alive_nodes
+                and rank not in self._draining}
+
+    def compute_shard_plan(self, node_rank: int) -> Tuple[Dict, bool]:
+        """The deterministic parallelism plan for the (forming) world
+        ``node_rank`` belongs to (parallel/planner.py): DP×TP×PP(×DCN)
+        mesh + batch/accumulation shape, stamped with the rendezvous
+        generation token and the world epoch (same staleness
+        discipline as restore plans). Returns (plan, changed) —
+        ``changed`` is True when the plan's execution shape differs
+        from the last stamped one (a REAL re-plan, not a re-stamp for
+        a late joiner)."""
+        from dlrover_tpu.parallel import planner
+
+        with self._lock:
+            world = self._plan_world_locked()
+            slices = (len({self._slices.get(r, -1) for r in world})
+                      if self._slice_mode_locked() and world else 1)
+            profile = planner.ModelProfile(
+                param_count=int(self._model_profile.get(
+                    "param_count", 0)),
+                param_bytes=int(self._model_profile.get(
+                    "param_bytes", 0)),
+                flops_per_token=float(self._model_profile.get(
+                    "flops_per_token", 0.0)),
+                peak_flops_per_chip=float(self._model_profile.get(
+                    "peak_flops_per_chip", 0.0)),
+                seq_len=int(self._model_profile.get("seq_len", 0)),
+                global_batch=int(self._model_profile.get(
+                    "global_batch", 0)),
+                hbm_bytes_per_chip=self._chip_hbm_bytes,
+                tensor_divisor=int(self._model_profile.get(
+                    "tensor_divisor", 0)),
+                fsdp_divisor=int(self._model_profile.get(
+                    "fsdp_divisor", 0)),
+            )
+            if self._slice_mode_locked() and node_rank in self._slices:
+                sid = self._slices[node_rank]
+                generation = self._slice_generation.get(sid, 0)
+                round_ = self._slice_rounds.get(sid, 0)
+            else:
+                generation = self._rdzv_round
+                round_ = self._rdzv_round
+            inputs = (tuple(sorted(world.items())), profile,
+                      max(1, slices), generation, self._world_epoch,
+                      round_)
+            if (self._last_plan is not None
+                    and inputs == self._last_plan_inputs):
+                # identical inputs → identical (deterministic) plan:
+                # answer the memo instead of re-enumerating the mesh
+                # space under the lock for every join/plan poll
+                return dict(self._last_plan), False
+            plan = planner.plan_parallelism(
+                world, profile, slices=max(1, slices),
+                prev_plan=self._last_plan, generation=generation,
+                epoch=self._world_epoch, round_=round_)
+            self._last_plan_inputs = inputs
+            equivalent = planner.plans_equivalent(self._last_plan, plan)
+            # a REAL re-plan needs a previous plan to differ from AND a
+            # world that has ever formed — bootstrap joins refine the
+            # first plan as members arrive, which is formation, not a
+            # resize (no replan events, no MFU re-anchor churn)
+            has_cut = (any(self._slice_rounds.values())
+                       if self._slice_mode_locked()
+                       else self._rdzv_round > 0)
+            changed = (self._last_plan is not None and has_cut
+                       and not equivalent)
+            prev = None
+            if not equivalent:
+                prev = self._last_plan
+                self._last_plan = plan
+                self._mutations += 1
+        if changed and prev is not None:
+            obs.get_flight_recorder().record_event(
+                "replan_stamped", rdzv=self.name,
+                world_size=plan.get("world_size"),
+                devices=plan.get("total_devices"),
+                mesh=plan.get("mesh"), prev_mesh=prev.get("mesh"),
+                global_batch=plan.get("global_batch"),
+                batch_adjusted=plan.get("batch_adjusted"),
+                resharded=plan.get("resharded"),
+                generation=plan.get("generation"),
+                epoch=plan.get("epoch"))
+        return plan, changed
+
+    @property
+    def last_shard_plan(self) -> Optional[Dict]:
+        with self._lock:
+            return dict(self._last_plan) if self._last_plan else None
+
+    def compute_restore_plan(self, node_rank: int,
+                             stripe: bool = False) -> Dict:
         """For each staged shard a restoring rank may need, which
         surviving donor serves it. Donors: alive, not draining, staged
         at the newest common step (mixing steps would assemble a state
@@ -392,7 +560,14 @@ class RendezvousManager:
         SAME-SLICE donors (ICI bandwidth) before cross-slice (DCN)
         ones, round-robin within each tier. Stamped with the world
         epoch — the staleness guard. Pure dict work under the lock;
-        JSON encoding is the caller's business."""
+        JSON encoding is the caller's business.
+
+        ``stripe`` (the re-plan migration mode): each entry lists EVERY
+        same-step holder (same-slice donors first) so the receiver can
+        fetch contiguous byte RANGES of one shard from several donors
+        in parallel — who sends which shard slice to whom when the
+        target sharding differs from the source
+        (checkpoint/peer_restore.py ``fetch_shards``)."""
         with self._lock:
             stores = {
                 rank: store
@@ -423,6 +598,20 @@ class RendezvousManager:
                 ranks = holders[key]
                 if node_rank in ranks:
                     donor, tier = node_rank, "local"
+                elif stripe and len(ranks) > 1:
+                    # resharding migration: order every holder
+                    # same-slice first, then the rest — the receiver
+                    # stripes the shard's bytes across them in parallel
+                    same = [r for r in ranks
+                            if requester_slice >= 0
+                            and self._slices.get(r, -1)
+                            == requester_slice]
+                    ordered = same + [r for r in ranks if r not in same]
+                    entries[key] = {
+                        "ranks": ordered,
+                        "addrs": [at_step[r]["addr"] for r in ordered],
+                        "tier": "striped"}
+                    continue
                 else:
                     same = [r for r in ranks
                             if requester_slice >= 0
@@ -439,11 +628,14 @@ class RendezvousManager:
                 entries[key] = {"rank": donor,
                                 "addr": at_step[donor]["addr"],
                                 "tier": tier}
-            return {
+            plan = {
                 "epoch": epoch, "step": step, "entries": entries,
                 "donors": {rank: at_step[rank]["addr"]
                            for rank in at_step},
             }
+            if stripe:
+                plan["mode"] = "stripe"
+            return plan
 
     def reap_dead_nodes(self, timeout_s: float) -> None:
         """Declare ranks silent for > timeout_s dead (world invalidation
@@ -572,6 +764,9 @@ class RendezvousManager:
             self._record_slice_locked(node_rank, slice_id)
             self._waiting[node_rank] = _WaitingNode(node_rank,
                                                     local_world_size)
+            # the planner's expected-world chip map (kept across world
+            # invalidations; see _plan_world_locked)
+            self._known_chips[node_rank] = local_world_size
             self._alive_nodes.add(node_rank)
             self._last_seen[node_rank] = time.time()
             self._pending_rejoin.discard(node_rank)
@@ -822,6 +1017,17 @@ class RendezvousManager:
                 "slice_generation": {
                     str(sid): g for sid, g
                     in self._slice_generation.items()},
+                # online re-planning: the model profile and the last
+                # stamped plan must survive a master failover — a
+                # restarted master that forgot them would stamp a
+                # migration-blind plan (and mis-detect a "re-plan")
+                # on the first join it serves
+                "model_profile": dict(self._model_profile),
+                "chip_hbm_bytes": self._chip_hbm_bytes,
+                "last_plan": (dict(self._last_plan)
+                              if self._last_plan else None),
+                "known_chips": {str(r): n for r, n
+                                in self._known_chips.items()},
             }
             # subclass fields join the SAME cut: one lock acquisition,
             # never two cuts with a mutation in between
@@ -883,6 +1089,20 @@ class RendezvousManager:
             self._slice_generation = {
                 int(sid): int(g) for sid, g in
                 (state.get("slice_generation") or {}).items()}
+            self._model_profile = {
+                str(k): float(v) for k, v in
+                (state.get("model_profile") or {}).items()}
+            self._chip_hbm_bytes = int(state.get("chip_hbm_bytes", 0))
+            last_plan = state.get("last_plan")
+            self._last_plan = (dict(last_plan)
+                               if isinstance(last_plan, dict) else None)
+            self._known_chips = {
+                int(r): int(n) for r, n in
+                (state.get("known_chips") or {}).items()}
+            # the memo key is not exported: the first post-restore ask
+            # recomputes (and, being deterministic, re-stamps the same
+            # plan without a spurious changed flag)
+            self._last_plan_inputs = None
             self._slice_round_start = {}
             # every restored member gets a fresh liveness clock: agents
             # re-register within their poll interval, the genuinely dead

@@ -206,12 +206,32 @@ class MasterServicer:
                 # the staleness guard's commit-time check: just the
                 # current world epoch, no plan computation
                 return msg.RestorePlan(epoch=mgr.world_epoch)
-            plan = mgr.compute_restore_plan(request.node_rank)
+            plan = mgr.compute_restore_plan(
+                request.node_rank,
+                stripe=bool(getattr(request, "stripe", False)))
             return msg.RestorePlan(
                 plan_json=json.dumps(plan),
                 epoch=int(plan.get("epoch", 0)),
                 step=int(plan.get("step", -1)),
                 found=bool(plan.get("entries")))
+        if isinstance(request, msg.ShardPlanRequest):
+            import json
+
+            mgr = self.rdzv_managers.get(
+                request.rdzv_name or RendezvousName.TRAINING)
+            if mgr is None:
+                return msg.ShardPlanResult()
+            before = mgr.mutation_count
+            plan, changed = mgr.compute_shard_plan(request.node_rank)
+            if changed:
+                self._note_replan(plan)
+            if mgr.mutation_count != before:
+                self._sink_state()   # a new plan was stamped
+            return msg.ShardPlanResult(
+                plan_json=json.dumps(plan),
+                epoch=int(plan.get("epoch", 0)),
+                generation=int(plan.get("generation", 0)),
+                found=bool(plan.get("mesh")))
         if isinstance(request, msg.KVGetRequest):
             return msg.KeyValuePair(key=request.key,
                                     value=self.kv_store.get(request.key))
@@ -293,6 +313,7 @@ class MasterServicer:
                 self._push_slice_map(mgr)
             self._sink_state()
             plan_json = ""
+            shard_plan_json = ""
             if request.rdzv_name == RendezvousName.TRAINING:
                 # the restore plan rides the join result: which
                 # surviving donor serves each staged shard this rank
@@ -304,9 +325,29 @@ class MasterServicer:
                 plan = mgr.compute_restore_plan(request.node_rank)
                 if plan.get("entries"):
                     plan_json = json.dumps(plan)
-            return msg.JoinRendezvousResult(round=rdzv_round,
-                                            generation=self.generation,
-                                            restore_plan_json=plan_json)
+                # the parallelism plan for the world this join is
+                # forming (parallel/planner.py): the same deterministic
+                # mesh + batch shape for every rank of the new world,
+                # so the resize resolves in ONE rendezvous round
+                try:
+                    before = mgr.mutation_count
+                    shard_plan, changed = mgr.compute_shard_plan(
+                        request.node_rank)
+                    shard_plan_json = json.dumps(shard_plan)
+                    if changed:
+                        self._note_replan(shard_plan)
+                    if mgr.mutation_count != before:
+                        self._sink_state()   # the stamped plan is state
+                except Exception:  # noqa: BLE001 — the planner must
+                    # never fail a join; workers fall back to their
+                    # configured mesh (loud replan_fallback on their
+                    # side)
+                    logger.exception("shard-plan computation failed "
+                                     "for rank %d", request.node_rank)
+            return msg.JoinRendezvousResult(
+                round=rdzv_round, generation=self.generation,
+                restore_plan_json=plan_json,
+                shard_plan_json=shard_plan_json)
         elif isinstance(request, msg.ReconnectRequest):
             return self._handle_reconnect(request)
         elif isinstance(request, msg.DrainReport):
@@ -358,6 +399,15 @@ class MasterServicer:
                 self.metric_collector.collect_node_stats(request)
             if self.diagnosis_manager is not None:
                 self.diagnosis_manager.observe_resource_stats(request)
+            # observed per-chip HBM totals bound the planner's
+            # memory-fit term (parallel/planner.py)
+            hbm_mb = max((c.hbm_total_mb for c in request.chip_stats),
+                         default=0.0)
+            if hbm_mb > 0:
+                training = self.rdzv_managers.get(
+                    RendezvousName.TRAINING)
+                if training is not None:
+                    training.set_chip_hbm(int(hbm_mb * (1 << 20)))
             # the ResourceMonitor's payload made scrapeable on the master
             obs.publish_node_stats(request)
         elif isinstance(request, msg.NodeHeartbeat):
@@ -429,13 +479,34 @@ class MasterServicer:
                 self.job_manager.collect_model_info(request)
             if self.metric_collector is not None:
                 self.metric_collector.collect_model_info(request)
-            # tokens/s exposition = steps/s × tokens-per-step
+            # tokens/s exposition = steps/s × tokens-per-step (the
+            # EFFECTIVE batch when a re-plan adjusted it)
+            effective = (getattr(request, "effective_global_batch", 0)
+                         or request.batch_size)
             self.speed_monitor.set_tokens_per_step(
-                request.batch_size * request.seq_len)
-            # MFU exposition = tokens/s × FLOPs/token / aggregate peak
+                effective * request.seq_len,
+                seq_len=request.seq_len)
+            # MFU exposition = tokens/s × FLOPs/token / aggregate peak;
+            # the per-chip peak is kept so a world re-plan can
+            # re-anchor the denominator to the NEW chip count without
+            # waiting for the next worker report
             self.speed_monitor.set_model_flops(
                 request.flops_per_token,
-                request.peak_flops_per_chip * max(1, request.chips))
+                request.peak_flops_per_chip * max(1, request.chips),
+                peak_flops_per_chip=request.peak_flops_per_chip)
+            # the planner's model profile (parallel/planner.py)
+            training = self.rdzv_managers.get(RendezvousName.TRAINING)
+            if training is not None:
+                training.set_model_profile(
+                    param_count=request.param_count,
+                    param_bytes=request.param_bytes,
+                    flops_per_token=request.flops_per_token,
+                    peak_flops_per_chip=request.peak_flops_per_chip,
+                    seq_len=request.seq_len,
+                    global_batch=request.batch_size,
+                    tensor_divisor=getattr(request, "tensor_divisor",
+                                           0),
+                    fsdp_divisor=getattr(request, "fsdp_divisor", 0))
         elif isinstance(request, msg.TelemetryReport):
             self._ingest_telemetry(request)
         else:
@@ -579,6 +650,25 @@ class MasterServicer:
         self._sink_state()
         return msg.DrainResult(success=True,
                                checkpoint_ranks=checkpoint_ranks)
+
+    # ------------------------------------------------------------------
+    def _note_replan(self, plan: Dict) -> None:
+        """A REAL re-plan was stamped (the execution shape changed):
+        attribute the next world re-formation to it in the goodput
+        ledger, and re-anchor the speed monitor's denominators — the
+        tokens/s and MFU gauges must not report the new world against
+        the old chip count or the old (possibly adjusted) batch."""
+        if self.goodput_ledger is not None:
+            self.goodput_ledger.note_elasticity_event("replan")
+        tokens_per_step = (int(plan.get("global_batch", 0) or 0)
+                           * int(self.speed_monitor.seq_len_hint or 0))
+        self.speed_monitor.reanchor_plan(
+            chips=int(plan.get("total_devices", 0) or 0),
+            tokens_per_step=tokens_per_step)
+        obs.get_registry().counter(
+            "dlrover_tpu_replans_total",
+            "Parallelism re-plans stamped (the execution shape "
+            "changed at a resize)").inc()
 
     # ------------------------------------------------------------------
     def _push_slice_map(self, mgr) -> None:

@@ -65,10 +65,15 @@ class SpeedMonitor:
         self._start_training_time: Optional[float] = None
         self._paused_time_s: float = 0.0
         self._tokens_per_step: int = 0
+        self._seq_len: int = 0
         # model-FLOPs accounting (obs/mfu.py, fed by ModelInfo): the
-        # job's MFU exposition is tokens/s × flops_per_token / peak
+        # job's MFU exposition is tokens/s × flops_per_token / peak.
+        # The per-chip peak is kept separately so a parallelism re-plan
+        # can re-anchor the aggregate to the NEW chip count instead of
+        # reporting post-resize MFU against the old denominator.
         self._flops_per_token: float = 0.0
         self._peak_flops_total: float = 0.0
+        self._peak_flops_per_chip: float = 0.0
         # set at membership change: the NEXT step-report delta spans the
         # failover gap (rendezvous + recompile + restore), not step time
         self._skip_next_step_time = False
@@ -230,15 +235,26 @@ class SpeedMonitor:
             if self._start_training_time is None:
                 self._start_training_time = time.time()
 
-    def set_tokens_per_step(self, tokens: int) -> None:
+    def set_tokens_per_step(self, tokens: int, seq_len: int = 0) -> None:
         """From ModelInfo (batch_size × seq_len): scales steps/s into the
         tokens/s exposition series."""
         with self._lock:
             if tokens > 0:
                 self._tokens_per_step = int(tokens)
+            if seq_len > 0:
+                self._seq_len = int(seq_len)
+
+    @property
+    def seq_len_hint(self) -> int:
+        """Last reported sequence length (0 = never reported): lets a
+        re-plan derive the new tokens-per-step from its planned batch
+        before any worker of the new world has reported."""
+        with self._lock:
+            return self._seq_len
 
     def set_model_flops(self, flops_per_token: float,
-                        peak_flops_total: float) -> None:
+                        peak_flops_total: float,
+                        peak_flops_per_chip: float = 0.0) -> None:
         """From ModelInfo: the FLOPs model + aggregate peak that turn the
         tokens/s series into the MFU gauge."""
         with self._lock:
@@ -246,6 +262,29 @@ class SpeedMonitor:
                 self._flops_per_token = float(flops_per_token)
             if peak_flops_total > 0.0:
                 self._peak_flops_total = float(peak_flops_total)
+            if peak_flops_per_chip > 0.0:
+                self._peak_flops_per_chip = float(peak_flops_per_chip)
+
+    def reanchor_plan(self, chips: int = 0,
+                      tokens_per_step: int = 0) -> None:
+        """A parallelism re-plan changed the world's execution shape:
+        recompute every denominator derived from it. The aggregate
+        peak re-anchors to the NEW chip count (from the stored
+        per-chip peak) and tokens/s to the planned (possibly
+        deliberately adjusted) batch — post-resize MFU must never be
+        reported against the old world's denominators. Windowed
+        samples and the peak-speed baseline reset like any membership
+        change (they describe the OLD shape's throughput)."""
+        with self._lock:
+            if tokens_per_step > 0:
+                self._tokens_per_step = int(tokens_per_step)
+            if chips > 0 and self._peak_flops_per_chip > 0.0:
+                self._peak_flops_total = (self._peak_flops_per_chip
+                                          * chips)
+            self._samples.clear()
+            self._skip_next_step_time = True
+            self._peak_speed = 0.0
+            self._worker_times.clear()
 
     def _model_flops(self) -> float:
         with self._lock:
@@ -382,8 +421,10 @@ class SpeedMonitor:
         with self._lock:
             return {"global_step": self._global_step,
                     "tokens_per_step": self._tokens_per_step,
+                    "seq_len": self._seq_len,
                     "flops_per_token": self._flops_per_token,
-                    "peak_flops_total": self._peak_flops_total}
+                    "peak_flops_total": self._peak_flops_total,
+                    "peak_flops_per_chip": self._peak_flops_per_chip}
 
     def restore_state(self, state: dict) -> None:
         """Rehydrate the step high-water mark so post-failover hang
@@ -393,10 +434,13 @@ class SpeedMonitor:
         with self._lock:
             self._global_step = int(state.get("global_step", 0))
             self._tokens_per_step = int(state.get("tokens_per_step", 0))
+            self._seq_len = int(state.get("seq_len", 0))
             self._flops_per_token = float(
                 state.get("flops_per_token", 0.0))
             self._peak_flops_total = float(
                 state.get("peak_flops_total", 0.0))
+            self._peak_flops_per_chip = float(
+                state.get("peak_flops_per_chip", 0.0))
             self._last_step_time = time.time()
             self._samples.clear()
             self._skip_next_step_time = True

@@ -127,6 +127,11 @@ class GoodputLedger:
         self._state_slice: Dict[int, str] = {}
         self._seen_span_ids: deque = deque(maxlen=_SEEN_SPAN_CAP)
         self._seen_set: set = set()
+        # online parallelism re-plans: the replan_plan/replan_migrate/
+        # replan_rebuild sub-phase spans (nested inside the restore/
+        # compile evidence — recorded here for the per-resize summary,
+        # NOT accrued again as wall-clock)
+        self._replans: deque = deque(maxlen=64)
         # (ts, rank, bucket, seconds) for windowed summaries
         self._window: deque = deque(maxlen=_WINDOW_CAP)
         self._job_start = self._now()
@@ -242,8 +247,8 @@ class GoodputLedger:
         sink + telemetry relay in a standalone process — are dropped)."""
         if not isinstance(record, dict):
             return False
-        bucket = classify_span(str(record.get("name", "")),
-                               record.get("attrs"))
+        name = str(record.get("name", ""))
+        bucket = classify_span(name, record.get("attrs"))
         span_id = record.get("span_id")
         try:
             duration = float(record.get("duration_s", 0.0))
@@ -258,6 +263,23 @@ class GoodputLedger:
                     self._seen_set.discard(self._seen_span_ids[0])
                 self._seen_span_ids.append(span_id)
                 self._seen_set.add(span_id)
+            if name.startswith("replan_") and duration >= 0.0:
+                # the re-plan sub-phase decomposition (plan → migrate →
+                # rebuild): per-resize evidence for the snapshot/tools
+                # view. These spans nest INSIDE the restore/compile
+                # evidence — recording them here never re-accrues their
+                # wall-clock.
+                attrs = record.get("attrs") or {}
+                self._replans.append({
+                    "phase": name[len("replan_"):],
+                    "rank": rank,
+                    "seconds": round(duration, 3),
+                    "ts": ts,
+                    "generation": attrs.get("generation", 0),
+                    "detail": {k: v for k, v in attrs.items()
+                               if k in ("source", "bytes", "resharded",
+                                        "applied", "mesh")},
+                })
             if not bucket or duration <= 0.0:
                 return False
             self._touch_locked(rank, ts + duration)
@@ -479,10 +501,31 @@ class GoodputLedger:
                 "incarnations": incarnations,
                 "degraded_steps_total": sum(
                     self._degraded_steps.values()),
+                "replans": self._replan_summary_locked(),
             }
         if window_s > 0.0:
             snap["window"] = self.window_summary(window_s)
         return snap
+
+    def _replan_summary_locked(self) -> List[Dict[str, Any]]:
+        """(lock held) One row per resize: the replan sub-phase spans
+        grouped by (rank, plan generation) — the per-event "what did
+        this re-plan cost vs a checkpoint round-trip" evidence
+        (tools/goodput.py, tools/diagnose.py)."""
+        grouped: Dict[Tuple[int, Any], Dict[str, Any]] = {}
+        for record in self._replans:
+            key = (record["rank"], record["generation"])
+            row = grouped.setdefault(key, {
+                "rank": record["rank"],
+                "generation": record["generation"],
+                "ts": record["ts"], "phases": {}, })
+            phases = row["phases"]
+            phases[record["phase"]] = round(
+                phases.get(record["phase"], 0.0) + record["seconds"], 3)
+            row["ts"] = max(row["ts"], record["ts"])
+            for k, v in record["detail"].items():
+                row.setdefault(k, v)
+        return sorted(grouped.values(), key=lambda r: r["ts"])
 
     def window_summary(self, window_s: float) -> Dict[str, Any]:
         """Buckets accrued over the trailing window, with the window's
@@ -711,6 +754,34 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
                 f"  rank {rank:>4}  {row_elapsed:8.1f}s elapsed  "
                 f"goodput {fraction:6.1%}  [{state}]{mfu_txt}  "
                 f"{detail}".rstrip())
+    replans = snap.get("replans", [])
+    if replans:
+        # per-resize pricing: the plan → migrate → rebuild legs of each
+        # online re-plan (vs the checkpoint round-trip it replaced)
+        lines.append("re-plans (plan / migrate / rebuild), per resize:")
+        for row in replans:
+            phases = row.get("phases", {})
+            legs = " ".join(
+                f"{phase}={phases[phase]:.2f}s"
+                for phase in ("plan", "migrate", "rebuild")
+                if phase in phases)
+            detail = []
+            if row.get("source"):
+                detail.append(f"source={row['source']}")
+            if row.get("bytes"):
+                detail.append(
+                    f"{float(row['bytes']) / (1 << 20):.1f}MiB moved")
+            if row.get("resharded"):
+                detail.append("resharded")
+            total = sum(phases.values())
+            lines.append(
+                "  rank {rank} gen {gen}: {total:.2f}s total  {legs}"
+                "{detail}".format(
+                    rank=row.get("rank", "?"),
+                    gen=row.get("generation", "?"),
+                    total=total, legs=legs,
+                    detail=("  [" + " ".join(detail) + "]")
+                    if detail else "").rstrip())
     incarnations = snap.get("incarnations", [])
     if incarnations:
         lines.append("time lost to elasticity events, per incarnation:")

@@ -134,6 +134,22 @@ class ElasticTrainLoop:
                 "MeshSpec.dcn, for multi-host slices)",
                 self._slice_id, slice_world)
             slice_mode = False
+        # online parallelism re-plan (parallel/planner.py): the master's
+        # deterministic mesh + batch shape for THIS world. Applied
+        # before the mesh is built; any failure is LOUD
+        # (replan_fallback flight event) and falls back to the
+        # configured shape — the checkpoint-restart path of old.
+        self._shard_plan: Optional[Dict[str, Any]] = None
+        self._plan_mesh_spec: Optional[MeshSpec] = None
+        self._replan_applied = ""       # "" | "batch" | "mesh+batch"
+        # True when the applied plan's execution shape differs from
+        # what the PREVIOUS incarnation ran (sidecar signature): only
+        # then is this rebuild a RESIZE worth pricing — a plain
+        # relaunch re-applying the unchanged plan must not mint
+        # replan_* spans the goodput tools read as "a resize happened"
+        self._replan_changed = False
+        self.global_batch = config.global_batch
+        self._trim_batch = 0
         if trainer is not None:
             self.trainer = trainer
             self.mesh = trainer.mesh
@@ -143,29 +159,22 @@ class ElasticTrainLoop:
             # custom trainers (pipeline) own their step: no split path
             slice_mode = slice_mode and trainer.grad_fn is not None
         else:
-            self.mesh = create_mesh(config.mesh_spec, devices)
-            self.dp = dp_size(self.mesh)
-            self.accum, self.micro_global = choose_accumulation(
-                config.global_batch, self.dp,
-                config.max_micro_per_replica,
-            )
-            import jax.numpy as jnp
-
-            sample = jnp.zeros((self.micro_global, config.seq_len),
-                               jnp.int32)
-            # the re-lower after an elastic resize: trace + shardings +
-            # jit wrappers for THIS world shape (XLA compile itself lands
-            # in the recompile/aot span, train_step.precompile)
-            with obs.span("recompile",
-                          {"phase": "relower",
-                           "devices": self.dp,
-                           "mesh": dict(self.mesh.shape)}):
-                self.trainer = build_trainer(
-                    model, tx, self.mesh, sample, loss_fn,
-                    accum_steps=self.accum, micro_batch=self.micro_global,
-                    rules=config.rules,
-                    split_grad_apply=slice_mode,
-                )
+            self._resolve_shard_plan(config, devices)
+            try:
+                self._build_dense_trainer(model, tx, loss_fn, config,
+                                          devices, slice_mode)
+            except Exception as e:  # noqa: BLE001 — a plan mesh the
+                # MODEL cannot shard over (an axis size not dividing a
+                # model dim the planner cannot see) must fall back to
+                # the configured shape, loudly — never a crash-looping
+                # worker
+                if self._plan_mesh_spec is None:
+                    raise
+                self._replan_fallback(
+                    self._shard_plan,
+                    f"planned mesh rejected by the model/trainer: {e}")
+                self._build_dense_trainer(model, tx, loss_fn, config,
+                                          devices, slice_mode)
         self._slice_sync = None
         if slice_mode:
             from dlrover_tpu.parallel.dcn_sync import SliceGradSync
@@ -204,6 +213,11 @@ class ElasticTrainLoop:
         self._peer_restorer = (
             PeerRestorer.from_env(client=self.client)
             if self.checkpointer is not None else None)
+        if self._peer_restorer is not None and self._replan_changed:
+            # re-plan migration: restore plans stripe each shard's byte
+            # ranges across every same-step holder (the resharding
+            # transfer primitive, checkpoint/peer_restore.py)
+            self._peer_restorer.stripe = True
         self._chaos = None  # built lazily: env may be set post-init
         self._prev_sigterm = None
         # per-step phase attribution (data-wait / h2d / compute /
@@ -247,6 +261,327 @@ class ElasticTrainLoop:
         self._flops_cross_checked = False
         self._report_model_info(model)
 
+    # -- online parallelism re-planning (parallel/planner.py) --------------
+    def _build_dense_trainer(self, model, tx, loss_fn, config, devices,
+                             slice_mode) -> None:
+        """Mesh + accumulation + jitted programs for the current shape
+        (the planned mesh when a shard plan applied, the configured one
+        otherwise). The trace is PROBED via ``abstract_state`` before
+        returning so an invalid planned mesh fails here — inside the
+        caller's fallback — instead of at first restore/step."""
+        import contextlib
+
+        import jax.numpy as jnp
+
+        mesh_spec = self._plan_mesh_spec or config.mesh_spec
+        self.mesh = create_mesh(mesh_spec, devices)
+        self.dp = dp_size(self.mesh)
+        if self.global_batch % self.dp:
+            # the last line of "any world size": even the fallback
+            # (configured) mesh must not crash-loop on a world whose dp
+            # does not divide the batch — apply the planner's
+            # round-DOWN-to-dp rule locally, loudly (the same
+            # deliberate adjustment, never a silent wrong batch)
+            adjusted = (self.global_batch // self.dp) * self.dp
+            if adjusted <= 0:
+                raise ValueError(
+                    f"dp size {self.dp} exceeds the global batch "
+                    f"{self.global_batch}: no mesh over this world can "
+                    f"hold even one sample per replica")
+            logger.error(
+                "world dp %d does not divide the global batch %d: "
+                "DELIBERATELY adjusting it to %d (input batches are "
+                "trimmed; the sampler advances by the adjusted size)",
+                self.dp, self.global_batch, adjusted)
+            obs.get_flight_recorder().record_event(
+                "replan_batch_adjusted", dp=self.dp,
+                requested=self.global_batch, adjusted=adjusted,
+                planned=self._plan_mesh_spec is not None)
+            self.global_batch = adjusted
+            self._trim_batch = adjusted
+        self.accum, self.micro_global = choose_accumulation(
+            self.global_batch, self.dp,
+            config.max_micro_per_replica,
+        )
+        sample = jnp.zeros((self.micro_global, config.seq_len),
+                           jnp.int32)
+        # the re-lower after an elastic resize: trace + shardings +
+        # jit wrappers for THIS world shape (XLA compile itself lands
+        # in the recompile/aot span, train_step.precompile). Under a
+        # re-plan the whole rebuild additionally lands in a
+        # `replan_rebuild` span — the "rebuild" leg of the re-plan
+        # decomposition (plan → migrate → rebuild) the goodput tools
+        # price per resize. The nested relower `recompile` span
+        # stays the ledger's compile evidence (no double count).
+        rebuild_cm = (
+            obs.span("replan_rebuild",
+                     {"generation": self._shard_plan.get(
+                         "generation", 0),
+                      "mesh": dict(self.mesh.shape)})
+            if self._replan_applied and self._replan_changed
+            else contextlib.nullcontext())
+        with rebuild_cm, obs.span(
+                "recompile",
+                {"phase": "relower",
+                 "devices": self.dp,
+                 "mesh": dict(self.mesh.shape)}):
+            trainer = build_trainer(
+                model, tx, self.mesh, sample, loss_fn,
+                accum_steps=self.accum, micro_batch=self.micro_global,
+                rules=config.rules,
+                split_grad_apply=slice_mode,
+            )
+            if self._plan_mesh_spec is not None:
+                import jax
+
+                # cheap shape-only probe: surfaces "axis does not
+                # divide dim" sharding rejections NOW (they otherwise
+                # raise lazily at the first eval_shape/step)
+                trainer.abstract_state(jax.random.PRNGKey(0))
+        self.trainer = trainer
+
+    def _resolve_shard_plan(self, config, devices=None) -> None:
+        """Fetch + apply the master's parallelism plan for this world.
+
+        The plan decides the mesh spec AND the (possibly deliberately
+        adjusted) global batch before anything is traced, so a resize
+        to ANY world size re-plans instead of crashing on a
+        non-divisor batch. No plan at all (standalone runs, masters
+        predating the planner) is silent — that is not a failure; a
+        plan that cannot be applied is a LOUD ``replan_fallback``."""
+        import json
+
+        from dlrover_tpu.common.config import Context
+        from dlrover_tpu.common.constants import NodeEnv
+
+        if not Context.singleton().replan_enabled:
+            return
+        import time as _time
+
+        t0 = _time.monotonic()
+        plan = None
+        if self.client is not None:
+            try:
+                plan = self.client.get_shard_plan() or None
+            except Exception:  # noqa: BLE001 — degrade to the file
+                logger.warning("shard-plan RPC failed; trying the "
+                               "join-result plan file",
+                               exc_info=True)
+        if plan is None:
+            path = os.environ.get(NodeEnv.SHARD_PLAN_FILE, "")
+            if path:
+                try:
+                    with open(path) as f:
+                        loaded = json.load(f)
+                    if isinstance(loaded, dict) and \
+                            loaded.get("mesh"):
+                        plan = loaded
+                except (OSError, json.JSONDecodeError):
+                    pass
+        if plan is None:
+            return
+        try:
+            self._apply_shard_plan(plan, config, devices)
+        except Exception as e:  # noqa: BLE001 — the fallback path
+            # must always be reachable: a broken plan falls back to
+            # the configured shape, loudly, never a wedged worker
+            self._replan_fallback(plan,
+                                  f"plan application failed: {e}")
+        if self._replan_changed:
+            # the "plan" leg of the per-resize pricing — recorded only
+            # when this rebuild IS a resize (see _replan_changed)
+            obs.record_span(
+                "replan_plan", _time.monotonic() - t0,
+                attrs={"applied": self._replan_applied,
+                       "generation": plan.get("generation", 0),
+                       "epoch": plan.get("epoch", 0)})
+
+    def _applied_plan_signature(self, plan: Dict[str, Any],
+                                batch: int) -> str:
+        """The execution shape this incarnation will run, as a stable
+        string (mesh + effective batch + device count)."""
+        import json
+
+        return json.dumps({"mesh": plan.get("mesh"),
+                           "global_batch": batch,
+                           "total_devices": plan.get("total_devices"),
+                           "applied": self._replan_applied},
+                          sort_keys=True)
+
+    def _note_replan_changed(self, plan: Dict[str, Any],
+                             batch: int) -> None:
+        """Decide whether this application is a RESIZE (shape differs
+        from the previous incarnation's, remembered in a sidecar next
+        to the agent-published plan file) or a plain relaunch
+        re-applying the same plan. No sidecar path (RPC-only runs) →
+        no memory → treated as changed. The sidecar is only READ
+        here — it is written once the migration actually completes
+        (``_commit_applied_plan``), so a worker that dies mid-resize
+        re-runs (and re-prices) the resize on respawn instead of being
+        misread as a plain relaunch."""
+        from dlrover_tpu.common.constants import NodeEnv
+
+        self._pending_plan_signature = self._applied_plan_signature(
+            plan, batch)
+        path = os.environ.get(NodeEnv.SHARD_PLAN_FILE, "")
+        if not path:
+            self._replan_changed = True
+            return
+        previous = None
+        try:
+            with open(f"{path}.applied") as f:
+                previous = f.read()
+        except OSError:
+            pass
+        self._replan_changed = previous != self._pending_plan_signature
+
+    def _commit_applied_plan(self) -> None:
+        """The resize completed (state restored/migrated under the new
+        shape): remember the applied signature so the NEXT incarnation
+        can tell a plain relaunch from a resize."""
+        signature = getattr(self, "_pending_plan_signature", None)
+        if not signature:
+            return
+        from dlrover_tpu.common.constants import NodeEnv
+
+        path = os.environ.get(NodeEnv.SHARD_PLAN_FILE, "")
+        if not path:
+            return
+        try:
+            tmp = f"{path}.applied.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                f.write(signature)
+            os.replace(tmp, f"{path}.applied")
+        except OSError:
+            pass
+
+    def _apply_shard_plan(self, plan: Dict[str, Any], config,
+                          devices=None) -> None:
+        import math
+
+        import jax
+
+        from dlrover_tpu.parallel import planner
+
+        # base sanity (feasibility, mesh factors the planned devices,
+        # positive batch) is the shared helper's job; the device-count
+        # comparison is layered below because slice mode and the
+        # independent-replica harness legitimately build less than the
+        # plan's global device count
+        error = planner.validate_plan(plan, n_devices=0)
+        if error is not None:
+            self._replan_fallback(plan, error)
+            return
+        # slice mode builds the per-slice portion (dcn=1): each slice
+        # is its own jax program, the dcn axis lives in the host-level
+        # cross-slice sync (parallel/dcn_sync.py)
+        mesh_dict = (planner.slice_mesh(plan) if self._slice_id >= 0
+                     else dict(plan.get("mesh", {})))
+        mesh_total = math.prod(int(mesh_dict.get(k, 1)) for k in
+                               ("dcn", "data", "fsdp", "tensor", "pipe"))
+        n_devices = (len(devices) if devices is not None
+                     else jax.device_count())
+        world_size = max(1, int(plan.get("world_size", 1) or 1))
+        apply_mesh = mesh_total == n_devices
+        if not apply_mesh:
+            # the CPU multi-process harness runs each rank as an
+            # independent full replica (no cross-process SPMD): the
+            # global mesh cannot be built locally, but the BATCH plan —
+            # the part a divisor-unfriendly resize actually needs —
+            # still applies. Anything else is a real mismatch.
+            replica_mode = (jax.process_count() == 1
+                            and world_size > 1
+                            and mesh_total == n_devices * world_size)
+            if not replica_mode:
+                self._replan_fallback(
+                    plan, f"plan mesh covers {mesh_total} device(s); "
+                          f"this process sees {n_devices}")
+                return
+        # the batch contract: honor the planned batch when the plan was
+        # computed for the batch this loop was configured with; a plan
+        # from a stale profile adjusts LOCALLY by the same
+        # round-down-to-dp rule (deliberate either way, never silent)
+        planned_batch = int(plan.get("global_batch", 0) or 0)
+        requested = int(plan.get("requested_global_batch", 0) or 0)
+        if requested != config.global_batch \
+                or planned_batch > config.global_batch:
+            dp = int(plan.get("dp", 0) or 0) or 1
+            planned_batch, _ = planner.adjust_global_batch(
+                config.global_batch, dp)
+            if planned_batch <= 0:
+                self._replan_fallback(
+                    plan, f"planned dp {dp} exceeds the configured "
+                          f"global batch {config.global_batch}")
+                return
+        if apply_mesh:
+            self._plan_mesh_spec = MeshSpec(
+                data=int(mesh_dict.get("data", 1)),
+                fsdp=int(mesh_dict.get("fsdp", 1)),
+                tensor=int(mesh_dict.get("tensor", 1)),
+                pipe=int(mesh_dict.get("pipe", 1)),
+                dcn=int(mesh_dict.get("dcn", 1)),
+            )
+        self.global_batch = planned_batch
+        self._trim_batch = (planned_batch
+                            if planned_batch < config.global_batch
+                            else 0)
+        self._shard_plan = plan
+        self._replan_applied = "mesh+batch" if apply_mesh else "batch"
+        self._note_replan_changed(plan, planned_batch)
+        obs.get_flight_recorder().record_event(
+            "replan_applied",
+            applied=self._replan_applied,
+            changed=self._replan_changed,
+            mesh=mesh_dict,
+            global_batch=planned_batch,
+            requested_global_batch=config.global_batch,
+            batch_adjusted=planned_batch != config.global_batch,
+            resharded=bool(plan.get("resharded")),
+            generation=plan.get("generation", 0),
+            epoch=plan.get("epoch", 0),
+            world_size=world_size)
+        obs.get_registry().counter(
+            "dlrover_tpu_replan_applied_total",
+            "Parallelism plans applied at worker (re)build",
+            labelnames=("applied",),
+        ).labels(applied=self._replan_applied).inc()
+        if planned_batch != config.global_batch:
+            logger.warning(
+                "re-plan DELIBERATELY adjusted the global batch "
+                "%d -> %d (dp %s does not divide it); input batches "
+                "are trimmed, the sampler advances by the adjusted "
+                "size", config.global_batch, planned_batch,
+                plan.get("dp"))
+        logger.info(
+            "shard plan applied (%s): mesh=%s batch=%d generation=%s "
+            "epoch=%s", self._replan_applied, mesh_dict, planned_batch,
+            plan.get("generation"), plan.get("epoch"))
+
+    def _replan_fallback(self, plan: Optional[Dict[str, Any]],
+                         reason: str) -> None:
+        """The hard fallback: today's checkpoint-restart path (the
+        configured mesh + Orbax/peer restore at the configured batch).
+        Loud by contract — a planner or plan-application failure must
+        be visible in the flight dump, never a silently wrong shape."""
+        self._shard_plan = None
+        self._plan_mesh_spec = None
+        self._replan_applied = ""
+        self._replan_changed = False
+        self.global_batch = self.config.global_batch
+        self._trim_batch = 0
+        obs.get_flight_recorder().record_event(
+            "replan_fallback", reason=reason[:256],
+            generation=(plan or {}).get("generation", 0),
+            epoch=(plan or {}).get("epoch", 0),
+            mesh=(plan or {}).get("mesh"))
+        obs.get_registry().counter(
+            "dlrover_tpu_replan_fallbacks_total",
+            "Re-plans abandoned for the configured-shape "
+            "checkpoint-restart path").inc()
+        logger.error(
+            "parallelism re-plan falling back to the configured shape: "
+            "%s (the checkpoint-restart path still applies)", reason)
+
     def _report_model_info(self, model=None) -> None:
         """One-shot static stats to the master's resource optimizer
         (reference: profile_extractor → ModelInfo) plus the FLOPs model
@@ -260,7 +595,7 @@ class ElasticTrainLoop:
             param_count = sum(int(np.prod(l.shape)) for l in leaves)
             param_bytes = sum(
                 int(np.prod(l.shape)) * l.dtype.itemsize for l in leaves)
-            tokens_per_step = self.config.global_batch * self.config.seq_len
+            tokens_per_step = self.global_batch * self.config.seq_len
             cfg = getattr(model, "config", None)
             self._param_count = param_count
             self._param_bytes = param_bytes
@@ -288,6 +623,26 @@ class ElasticTrainLoop:
             self._peak_flops_total = peak_chip * max(1, chips)
             if self.client is None:
                 return
+            # dim-divisibility granules for the planner: a tensor way
+            # must divide every tensor-sharded dim (heads/kv/mlp/
+            # vocab), an fsdp way the embed dim — gcd'ed so the master
+            # can filter candidates it cannot trace-probe itself
+            import math as _math
+
+            tensor_dims = [int(getattr(cfg, k, 0) or 0)
+                           for k in ("num_heads", "n_head",
+                                     "num_kv_heads",
+                                     "intermediate_size", "vocab_size")]
+            tensor_dims = [d for d in tensor_dims if d > 0]
+            tensor_divisor = (_math.gcd(*tensor_dims)
+                              if tensor_dims else 0)
+            fsdp_divisor = int(getattr(cfg, "hidden_size", 0)
+                               or getattr(cfg, "n_embd", 0) or 0)
+            # batch_size = the CONFIGURED batch (the planner's
+            # requested baseline — reporting the adjusted one would
+            # ratchet the profile down after every adjusting resize);
+            # effective_global_batch = what this incarnation actually
+            # trains (the tokens/s denominator)
             self.client.report_model_info(
                 param_count=param_count, param_bytes=param_bytes,
                 flops_per_step=self._flops_per_token * tokens_per_step,
@@ -297,6 +652,9 @@ class ElasticTrainLoop:
                 peak_flops_per_chip=peak_chip,
                 chips=chips,
                 flops_source="analytic",
+                tensor_divisor=tensor_divisor,
+                fsdp_divisor=fsdp_divisor,
+                effective_global_batch=self.global_batch,
             )
         except Exception:   # noqa: BLE001 — stats are advisory
             logger.warning("model-info report failed", exc_info=True)
@@ -315,7 +673,7 @@ class ElasticTrainLoop:
             return
         self._flops_cross_checked = True
         measured = obs.mfu.cost_analysis_flops(compiled)
-        tokens_per_step = self.config.global_batch * self.config.seq_len
+        tokens_per_step = self.global_batch * self.config.seq_len
         adopted = obs.mfu.cross_check(self._flops_per_token, measured,
                                       tokens_per_step)
         if adopted is None:
@@ -333,6 +691,7 @@ class ElasticTrainLoop:
                     param_bytes=getattr(self, "_param_bytes", 0),
                     flops_per_step=adopted * tokens_per_step,
                     batch_size=self.config.global_batch,
+                    effective_global_batch=self.global_batch,
                     seq_len=self.config.seq_len,
                     flops_per_token=adopted,
                     peak_flops_per_chip=obs.mfu.peak_flops_per_chip(
@@ -378,6 +737,7 @@ class ElasticTrainLoop:
         timings: Dict[str, float] = {}
         self.last_restore_timings = timings
         with obs.span("restore_or_init") as restore_span:
+            t_migrate = _time.monotonic()
             compile_thread = None
             if (self.config.overlap_restore_compile
                     and hasattr(self.trainer, "precompile")):
@@ -463,6 +823,28 @@ class ElasticTrainLoop:
                                     "checkpoint")
                     timings["post_sync_s"] = round(
                         _time.monotonic() - t0, 2)
+            if self._shard_plan is not None and self._replan_changed:
+                # the "migrate" leg of the re-plan decomposition
+                # (plan → migrate → rebuild): live state landed under
+                # the NEW sharding — from peers when any survive, with
+                # the shard-wise Orbax fallback otherwise — WITHOUT a
+                # checkpoint round-trip on the happy path. Recorded as
+                # its own span (nested evidence for the flight dump /
+                # goodput tools; the restore_or_init span remains the
+                # ledger's restore bucket). Gated on _replan_changed: a
+                # plain relaunch re-applying the unchanged plan is not
+                # a resize and must not be priced as one.
+                migrate_s = _time.monotonic() - t_migrate
+                timings["replan_migrate_s"] = round(migrate_s, 3)
+                obs.record_span(
+                    "replan_migrate", migrate_s,
+                    attrs={"step": step,
+                           "source": self.last_restore_source,
+                           "bytes": timings.get("peer_bytes", 0.0),
+                           "generation": self._shard_plan.get(
+                               "generation", 0),
+                           "resharded": bool(self._shard_plan.get(
+                               "resharded"))})
             if compile_thread is not None:
                 t0 = _time.monotonic()
                 compile_thread.join()
@@ -484,6 +866,11 @@ class ElasticTrainLoop:
             # what the RESTORE produced — the catch-up is on top)
             state, step = self._maybe_slice_catch_up(state, step,
                                                      sampler)
+        # the migration landed: commit the applied-plan signature so a
+        # future PLAIN relaunch is not re-priced as a resize (a crash
+        # before this point deliberately leaves the old signature — the
+        # respawn re-runs the resize)
+        self._commit_applied_plan()
         self._flush_telemetry()
         return state, step
 
@@ -557,6 +944,13 @@ class ElasticTrainLoop:
                 tokens, targets = next(batch_iter)
             except StopIteration:
                 break
+            if self._trim_batch and len(tokens) > self._trim_batch:
+                # the re-plan's deliberate batch adjustment: the input
+                # pipeline still yields the configured batch; train on
+                # the planned (dp-divisible) prefix. Recorded once in
+                # the replan_applied event — never a silent truncation.
+                tokens = tokens[:self._trim_batch]
+                targets = targets[:self._trim_batch]
             t_data = _time.monotonic()
             self.profiler.poll(step - start_step)
             tok, tgt = self.trainer.shard_batch(tokens, targets)
@@ -569,7 +963,10 @@ class ElasticTrainLoop:
             # scripted fault injection (no-op unless DLROVER_TPU_CHAOS)
             self._chaos.maybe_inject(step)
             if sampler is not None:
-                sampler.record_batch(config.global_batch)
+                # the EFFECTIVE batch (re-plan adjusted when the world
+                # does not divide the configured one): the sampler's
+                # position advances by what was actually consumed
+                sampler.record_batch(self.global_batch)
             t_compute_end = _time.monotonic()
             # from AFTER the batch fetch, as before the timeline landed:
             # this series' meaning (dispatch-bound step time) must not
@@ -703,7 +1100,7 @@ class ElasticTrainLoop:
         ])
         if sampler is not None:
             for _ in range(max(0, fleet_step - start_step)):
-                sampler.record_batch(self.config.global_batch)
+                sampler.record_batch(self.global_batch)
         self.last_restore_timings["catch_up_steps"] = float(
             fleet_step - start_step)
         return adopted, fleet_step
@@ -801,7 +1198,7 @@ class ElasticTrainLoop:
         # achieved-vs-peak over the window: the step report's MFU field
         # feeds the master's per-rank gauge and the collapse rule
         self._maybe_cross_check_flops()
-        tokens_per_step = self.config.global_batch * self.config.seq_len
+        tokens_per_step = self.global_batch * self.config.seq_len
         mfu = obs.mfu.achieved_mfu(
             tokens_per_step / mean_step if mean_step > 0 else -1.0,
             self._flops_per_token, self._peak_flops_total)

@@ -190,6 +190,67 @@ def render_slices(payload: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# flight events describing an online parallelism re-plan
+# (parallel/planner.py + master/rendezvous.py + trainer/elastic_loop.py)
+_REPLAN_EVENTS = (
+    "replan_stamped", "replan_applied", "replan_fallback",
+)
+
+
+def render_replans(payload: Dict[str, Any]) -> str:
+    """Re-plan section of a flight dump: each resize's stamped plan
+    (old mesh → new mesh, batch adjustment), where it was applied, the
+    plan/migrate/rebuild sub-phase costs, and any loud fallback to the
+    checkpoint-restart path — the one-glance answer to "did the resize
+    re-plan in place, what did it cost, and did the batch change?"."""
+    events = [record for record in payload.get("events", [])
+              if record.get("kind") == "event"
+              and record.get("name") in _REPLAN_EVENTS]
+    spans = [record for record in payload.get("events", [])
+             if record.get("kind") == "span"
+             and str(record.get("name", "")).startswith("replan_")]
+    lines = [f"re-plan events: {len(events)} "
+             f"(+{len(spans)} sub-phase spans)"]
+    if not events and not spans:
+        return "\n".join(lines)
+    ordered = sorted(events, key=lambda e: e.get("ts", 0.0))
+    t0 = (ordered[0].get("ts", 0.0) if ordered
+          else min(s.get("ts", 0.0) for s in spans))
+    for record in ordered:
+        attrs = dict(record.get("attrs", {}))
+        mesh = attrs.pop("mesh", None)
+        prev = attrs.pop("prev_mesh", None)
+        detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        if mesh:
+            compact = "x".join(str(v) for v in (
+                mesh.get("dcn", 1), mesh.get("data", 1),
+                mesh.get("fsdp", 1), mesh.get("tensor", 1),
+                mesh.get("pipe", 1)))
+            arrow = ""
+            if prev:
+                arrow = "x".join(str(v) for v in (
+                    prev.get("dcn", 1), prev.get("data", 1),
+                    prev.get("fsdp", 1), prev.get("tensor", 1),
+                    prev.get("pipe", 1))) + " -> "
+            detail = (f"mesh[dcn,data,fsdp,tp,pp]={arrow}{compact} "
+                      + detail)
+        lines.append("+{offset:8.1f}s  {name:<18} {detail}".format(
+            offset=record.get("ts", 0.0) - t0,
+            name=str(record.get("name", "?")),
+            detail=detail).rstrip())
+    # sub-phase rollup: plan / migrate / rebuild per resize
+    by_phase: Dict[str, float] = {}
+    for record in spans:
+        phase = str(record.get("name", ""))[len("replan_"):]
+        by_phase[phase] = (by_phase.get(phase, 0.0)
+                           + float(record.get("duration_s", 0.0)))
+    if by_phase:
+        lines.append("  sub-phase totals: " + " ".join(
+            f"{phase}={seconds:.2f}s"
+            for phase, seconds in sorted(by_phase.items())))
+    return "\n".join(lines)
+
+
 def render_goodput(payload: Dict[str, Any]) -> str:
     """Goodput-ledger section of a flight dump: the bucket split plus
     the per-incarnation badput attribution (obs/goodput.py). Dumps
@@ -297,6 +358,7 @@ def main(argv=None) -> int:
         print(render_lifecycle(payload))
         print(render_restore(payload))
         print(render_slices(payload))
+        print(render_replans(payload))
         print(render_goodput(payload))
     for path in ns.timeline:
         payload = _load_json(path)
