@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Control-plane bench: joins/s, KV ops/s, time-to-reform — sharded vs
+single-lock, at ~1k simulated ranks.
+
+The sharded control plane (master/rendezvous_shards.py) claims two
+things ROADMAP item 5 needs measured, not asserted:
+
+1. **joins/s scales with slice count.** The single-lock manager's
+   slice-ready check scans the WHOLE fleet's waiting list under ONE lock
+   for every poll — O(N) work serialized fleet-wide, O(N²) for a full
+   fleet formation. A shard scans only its slice (O(N/S)), under its own
+   lock. The bench forms a full fleet through the real join/poll/cut
+   protocol with a thread pool of simulated agents, both managers, same
+   driver.
+2. **Per-slice time-to-reform stays flat as the fleet grows.** After a
+   member death, the victim slice's re-join → cut latency is measured
+   while every OTHER rank keeps up its steady-state waiting-num poll
+   (the load that makes a single lock a bottleneck), across slice
+   counts.
+
+Plus the coordination tier's substrate numbers: KV set/get ops/s and the
+lock-free read's p99 while writers churn the condition variable.
+
+Usage:
+    python bench_controlplane.py                  # full (1024 ranks)
+    python bench_controlplane.py --smoke          # CI-sized, < ~60 s
+    python bench_controlplane.py --json out.json
+
+The smoke run is exercised as a slow test in tests/test_controlplane.py
+so these numbers land in CI artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+REPO = __file__.rsplit("/", 1)[0]
+sys.path.insert(0, REPO)
+
+from dlrover_tpu.master.kv_store import KVStoreService  # noqa: E402
+from dlrover_tpu.master.rendezvous import (  # noqa: E402
+    ElasticTrainingRendezvousManager,
+    RendezvousParameters,
+)
+from dlrover_tpu.master.rendezvous_shards import (  # noqa: E402
+    ShardedRendezvousManager,
+)
+
+
+def _build_manager(kind: str, ranks: int):
+    params = RendezvousParameters(min_nodes=1, max_nodes=ranks,
+                                  wait_new_node_s=30.0)
+    if kind == "sharded":
+        return ShardedRendezvousManager(params)
+    return ElasticTrainingRendezvousManager(params)
+
+
+def _preregister(mgr, ranks: int, slices: int) -> None:
+    """Teach the registry every rank's slice and aliveness up front so
+    each slice's round cuts exactly once, when its LAST member joins
+    (no transient partial worlds — same discipline as the replan
+    acceptance test)."""
+    for rank in range(ranks):
+        mgr.record_slice(rank, rank % slices)
+        mgr.add_alive_node(rank)
+
+
+def _form_fleet(mgr, ranks: int, slices: int, threads: int) -> float:
+    """Drive the real protocol: every rank joins, then polls until it
+    holds a cut world. Each pool thread simulates a COHORT of agents
+    (join them all, then round-robin their polls) so a slice's cut can
+    never starve on pool capacity. Returns the fleet's wall seconds."""
+    deadline = time.monotonic() + 600.0
+
+    def cohort(chunk) -> None:
+        for rank in chunk:
+            mgr.join_rendezvous(rank, 1, slice_id=rank % slices)
+        pending = set(chunk)
+        while pending:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"ranks {sorted(pending)[:4]}... never saw a cut "
+                    f"world")
+            for rank in list(pending):
+                _, _, world = mgr.get_comm_world(rank)
+                if world and rank in world:
+                    pending.discard(rank)
+            time.sleep(0.0005)
+
+    chunks = [list(range(ranks))[i::threads] for i in range(threads)]
+    start = time.monotonic()
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        for future in [pool.submit(cohort, c) for c in chunks if c]:
+            future.result()
+    return time.monotonic() - start
+
+
+def bench_joins(ranks: int, slices: int, threads: int) -> dict:
+    out: dict = {}
+    for kind in ("single_lock", "sharded"):
+        mgr = _build_manager(kind, ranks)
+        _preregister(mgr, ranks, slices)
+        wall = _form_fleet(mgr, ranks, slices, threads)
+        out[kind] = {"wall_s": round(wall, 4),
+                     "joins_per_s": round(ranks / wall, 1)}
+        assert len(mgr.latest_world) == ranks, (
+            f"{kind}: fleet never fully formed "
+            f"({len(mgr.latest_world)}/{ranks})")
+    out["speedup"] = round(out["sharded"]["joins_per_s"]
+                           / out["single_lock"]["joins_per_s"], 2)
+    return out
+
+
+def bench_reform(ranks: int, slice_counts, threads: int) -> dict:
+    """Victim-slice re-form latency under steady-state poll load, per
+    slice count. The victim is always slice 0; every surviving rank
+    polls num_nodes_waiting in the background (the monitor-tick load)."""
+    out: dict = {}
+    for kind in ("single_lock", "sharded"):
+        per_slices = {}
+        for slices in slice_counts:
+            mgr = _build_manager(kind, ranks)
+            _preregister(mgr, ranks, slices)
+            _form_fleet(mgr, ranks, slices, threads)
+            victims = [r for r in range(ranks) if r % slices == 0]
+            stop = threading.Event()
+
+            def poller(rank: int) -> None:
+                while not stop.is_set():
+                    mgr.num_nodes_waiting(rank)
+                    time.sleep(0.001)
+
+            pollers = [threading.Thread(target=poller, args=(r,),
+                                        daemon=True)
+                       for r in range(ranks) if r % slices != 0]
+            for thread in pollers:
+                thread.start()
+            try:
+                start = time.monotonic()
+                mgr.remove_alive_node(victims[0])
+                for rank in victims:
+                    mgr.join_rendezvous(rank, 1, slice_id=0)
+                while True:
+                    _, _, world = mgr.get_comm_world(victims[0])
+                    if world and set(world) == set(victims):
+                        break
+                    if time.monotonic() - start > 120.0:
+                        raise TimeoutError(
+                            f"{kind}/{slices}: slice never re-formed")
+                    time.sleep(0.0005)
+                per_slices[str(slices)] = round(
+                    (time.monotonic() - start) * 1000.0, 2)
+            finally:
+                stop.set()
+                for thread in pollers:
+                    thread.join(timeout=2.0)
+        out[kind] = per_slices
+    return out
+
+
+def bench_kv(ops: int, threads: int) -> dict:
+    """The coordination substrate: hot-key set/get throughput and the
+    lock-free read's p99 while writers churn the condition variable."""
+    kv = KVStoreService()
+    payload = b"x" * 4096
+
+    def setter(worker: int) -> int:
+        for i in range(ops):
+            kv.set(f"dcn/g0/grads/{worker}", payload)
+        return ops
+
+    start = time.monotonic()
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        total = sum(pool.map(setter, range(threads)))
+    set_wall = time.monotonic() - start
+
+    stop = threading.Event()
+
+    def churn() -> None:
+        i = 0
+        while not stop.is_set():
+            kv.set(f"dcn/g0/grads/{i % threads}", payload)
+            i += 1
+
+    churner = threading.Thread(target=churn, daemon=True)
+    churner.start()
+    latencies = []
+    start = time.monotonic()
+    reads = 0
+    try:
+        for i in range(ops * threads):
+            t0 = time.perf_counter()
+            kv.get(f"dcn/g0/grads/{i % threads}")
+            latencies.append(time.perf_counter() - t0)
+            reads += 1
+    finally:
+        stop.set()
+        churner.join(timeout=2.0)
+    get_wall = time.monotonic() - start
+    latencies.sort()
+    p99 = latencies[int(0.99 * (len(latencies) - 1))]
+    return {
+        "set_ops_per_s": round(total / set_wall, 1),
+        "get_ops_per_s": round(reads / get_wall, 1),
+        "get_p50_us": round(
+            statistics.median(latencies) * 1e6, 2),
+        "get_p99_us": round(p99 * 1e6, 2),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("control-plane bench")
+    parser.add_argument("--ranks", type=int, default=1024,
+                        help="simulated fleet size (>= 1k for the "
+                             "headline numbers)")
+    parser.add_argument("--slices", type=int, default=16)
+    parser.add_argument("--threads", type=int, default=32,
+                        help="simulated-agent thread pool")
+    parser.add_argument("--kv-ops", type=int, default=2000,
+                        help="kv ops per thread")
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI-sized run (fewer ranks/ops)")
+    parser.add_argument("--json", default="",
+                        help="also write the result JSON here")
+    ns = parser.parse_args(argv)
+    if ns.smoke:
+        ns.ranks = min(ns.ranks, 192)
+        ns.slices = min(ns.slices, 8)
+        ns.threads = min(ns.threads, 16)
+        ns.kv_ops = min(ns.kv_ops, 300)
+    reform_slices = sorted({2, max(2, ns.slices // 2), ns.slices})
+
+    result = {
+        "ranks": ns.ranks, "slices": ns.slices, "threads": ns.threads,
+        "smoke": bool(ns.smoke),
+    }
+    print(f"# joins/s: {ns.ranks} ranks x {ns.slices} slices, "
+          f"{ns.threads} agent threads", flush=True)
+    result["joins"] = bench_joins(ns.ranks, ns.slices, ns.threads)
+    print(json.dumps(result["joins"], indent=2), flush=True)
+    print(f"# per-slice time-to-reform over slice counts "
+          f"{reform_slices}", flush=True)
+    result["reform_ms"] = bench_reform(ns.ranks, reform_slices,
+                                       ns.threads)
+    print(json.dumps(result["reform_ms"], indent=2), flush=True)
+    print("# kv substrate", flush=True)
+    result["kv"] = bench_kv(ns.kv_ops, min(8, ns.threads))
+    print(json.dumps(result["kv"], indent=2), flush=True)
+
+    print("\n== control-plane bench ==")
+    joins = result["joins"]
+    print(f"joins/s: single-lock {joins['single_lock']['joins_per_s']}"
+          f" -> sharded {joins['sharded']['joins_per_s']}  "
+          f"({joins['speedup']}x)")
+    for kind in ("single_lock", "sharded"):
+        row = ", ".join(f"S={s}: {ms}ms"
+                        for s, ms in result["reform_ms"][kind].items())
+        print(f"reform[{kind}]: {row}")
+    kv = result["kv"]
+    print(f"kv: {kv['set_ops_per_s']} set/s, "
+          f"{kv['get_ops_per_s']} get/s, get p99 {kv['get_p99_us']}us")
+    if ns.json:
+        with open(ns.json, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"json -> {ns.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -346,6 +346,10 @@ class ElasticAgent:
         env.update(self._spec.env)
         env.update({
             NodeEnv.MASTER_ADDR: self._client.master_addr,
+            # the coordination tier the join result advertised ("" =
+            # single-tier): the worker's hot dcn/ traffic dials it
+            # directly (master/coord_service.py)
+            NodeEnv.COORD_ADDR: self._client.coord_addr,
             NodeEnv.NODE_ID: str(self._client.node_id),
             NodeEnv.NODE_RANK: str(self._client.node_rank),
             NodeEnv.WORLD_SIZE: str(len(ranks)),

@@ -22,7 +22,11 @@ from dlrover_tpu.common.comm import (
     local_ip,
 )
 from dlrover_tpu.common.config import Context
-from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.common.constants import (
+    HOT_KV_PREFIXES,
+    NodeEnv,
+    RendezvousName,
+)
 
 
 def backoff_delay_s(attempt: int, base_s: float, cap_s: float) -> float:
@@ -72,7 +76,8 @@ class MasterClient:
                  node_rank: Optional[int] = None,
                  timeout_s: Optional[float] = None,
                  node_type: str = "",
-                 slice_id: Optional[int] = None):
+                 slice_id: Optional[int] = None,
+                 coord_addr: Optional[str] = None):
         self.master_addr = master_addr
         self.node_id = node_id
         self.node_type = node_type
@@ -102,11 +107,51 @@ class MasterClient:
         self._channel = build_channel(master_addr)
         self._stub = MasterStub(self._channel,
                                 fault_injector=self._fault_injector)
+        # the coordination tier (master/coord_service.py): hot-prefix
+        # KV traffic (dcn/ gradient exchange, coord/ barriers) dials
+        # this address so it can never queue behind control-tier storms.
+        # "" = single-tier master; learned from the env, join results,
+        # or the bootstrap file.
+        self.coord_addr = ""
+        self._coord_channel = None
+        self._coord_stub = None
+        # breaker: after a coord-tier transport failure, hot traffic
+        # goes straight to the main tier until this deadline instead of
+        # paying a full RPC timeout per call against a dead tier
+        self._coord_down_until = 0.0
+        self.set_coord_addr(
+            coord_addr if coord_addr is not None
+            else os.getenv(NodeEnv.COORD_ADDR, ""))
 
-    def reconnect(self, master_addr: Optional[str] = None) -> None:
+    def set_coord_addr(self, coord_addr: str) -> None:
+        """(Re)dial the coordination tier; "" tears it down (hot traffic
+        falls back to the main channel)."""
+        if coord_addr == self.coord_addr and (
+                bool(coord_addr) == (self._coord_stub is not None)):
+            return
+        old = self._coord_channel
+        self.coord_addr = coord_addr or ""
+        self._coord_down_until = 0.0   # a fresh dial resets the breaker
+        if coord_addr:
+            self._coord_channel = build_channel(coord_addr)
+            self._coord_stub = MasterStub(
+                self._coord_channel,
+                fault_injector=self._fault_injector)
+        else:
+            self._coord_channel = None
+            self._coord_stub = None
+        if old is not None:
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001 — a dead channel may refuse
+                pass
+
+    def reconnect(self, master_addr: Optional[str] = None,
+                  coord_addr: Optional[str] = None) -> None:
         """Tear down the channel and dial (a possibly different) master.
         Existing typed wrappers keep working — they go through the new
-        stub on the next call."""
+        stub on the next call. The coordination tier is re-resolved
+        too: a promoted standby binds a fresh coord port."""
         addr = master_addr or self.master_addr
         try:
             self._channel.close()
@@ -116,23 +161,70 @@ class MasterClient:
         self._channel = build_channel(addr)
         self._stub = MasterStub(self._channel,
                                 fault_injector=self._fault_injector)
+        if coord_addr is not None:
+            self.set_coord_addr(coord_addr)
 
     @staticmethod
-    def resolve_master_addr(default: str = "") -> str:
-        """Where is the master NOW? The bootstrap file wins (a restarted
-        master atomically rewrites it with its new address); the env
-        contract is the fallback; then the caller's default."""
+    def resolve_bootstrap() -> dict:
+        """The bootstrap file's parsed contents: {"addr", "coord_addr",
+        "generation"} — JSON since the hot-standby work; a plain
+        pre-JSON file reads as {"addr": <contents>}. {} = no file."""
+        import json
+
         path = os.getenv(NodeEnv.MASTER_BOOTSTRAP, "") or (
             Context.singleton().master_bootstrap_file)
-        if path:
+        if not path:
+            return {}
+        try:
+            with open(path) as f:
+                raw = f.read().strip()
+        except OSError:
+            return {}
+        if not raw:
+            return {}
+        if raw.startswith("{"):
             try:
-                with open(path) as f:
-                    addr = f.read().strip()
-                if addr:
-                    return addr
-            except OSError:
-                pass
+                parsed = json.loads(raw)
+                if isinstance(parsed, dict) and parsed.get("addr"):
+                    return parsed
+            except ValueError:
+                return {}
+            return {}
+        return {"addr": raw}
+
+    @classmethod
+    def resolve_master_addr(cls, default: str = "") -> str:
+        """Where is the master NOW? The bootstrap file wins (a restarted
+        or PROMOTED master atomically rewrites it with its new address +
+        a bumped generation); the env contract is the fallback; then the
+        caller's default."""
+        bootstrap = cls.resolve_bootstrap()
+        if bootstrap.get("addr"):
+            return str(bootstrap["addr"])
         return os.getenv(NodeEnv.MASTER_ADDR, "") or default
+
+    def reresolve_if_moved(self) -> bool:
+        """Re-read the bootstrap file and re-dial when the master moved
+        (a promotion/restart while this process was mid-training). The
+        AGENT's master-lost loop does this itself; WORKER processes —
+        which learn addresses from env at spawn and are deliberately
+        not respawned on promotion — call this from their RPC failure
+        paths (e.g. parallel/dcn_sync) so a promoted master's slice
+        status/coordination serves again without a restart. No-op
+        without a bootstrap file."""
+        bootstrap = self.resolve_bootstrap()
+        addr = str(bootstrap.get("addr") or "")
+        if not addr or addr == self.master_addr:
+            return False
+        coord = str(bootstrap.get("coord_addr") or "")
+        logger_note = (f"master moved {self.master_addr} -> {addr} "
+                       f"(bootstrap generation "
+                       f"{bootstrap.get('generation', '?')}); re-dialing")
+        from dlrover_tpu.common.log import default_logger as logger
+
+        logger.warning(logger_note)
+        self.reconnect(addr, coord_addr=coord)
+        return True
 
     # -- raw --------------------------------------------------------------
     def _get(self, request: msg.Message) -> msg.Message:
@@ -165,8 +257,35 @@ class MasterClient:
                       expected: type) -> msg.Message:
         return self._typed(self._report, request, expected)
 
+    # -- coordination-tier routing ----------------------------------------
+    @staticmethod
+    def _is_hot_key(key: str) -> bool:
+        return key.startswith(HOT_KV_PREFIXES)
+
+    def _coord_send(self, kind: str, request: msg.Message,
+                    timeout_s: Optional[float] = None) -> msg.Message:
+        """Send a coordination RPC via the coordination tier when one is
+        dialed, falling back to the main tier (which answers every
+        coordination RPC too — single-tier masters, mid-promotion
+        windows) on any transport failure."""
+        payload = msg.serialize_message(request)
+        timeout = timeout_s if timeout_s is not None else self._timeout_s
+        stub = self._coord_stub
+        if stub is not None and time.monotonic() >= \
+                self._coord_down_until:
+            try:
+                send = stub.get if kind == "get" else stub.report
+                return msg.deserialize_message(
+                    send(payload, timeout=timeout))
+            except Exception:  # noqa: BLE001 — grpc errors vary
+                self._coord_down_until = time.monotonic() + 5.0
+        send = self._stub.get if kind == "get" else self._stub.report
+        return msg.deserialize_message(send(payload, timeout=timeout))
+
     def close(self) -> None:
         self._channel.close()
+        if self._coord_channel is not None:
+            self._coord_channel.close()
 
     # -- dynamic sharding -------------------------------------------------
     @retry_rpc()
@@ -228,6 +347,7 @@ class MasterClient:
                                               "restore_plan_json", "")
         self.last_shard_plan_json = getattr(result,
                                             "shard_plan_json", "")
+        self.set_coord_addr(getattr(result, "coord_addr", ""))
         return result.round
 
     def reconnect_report(self, local_world_size: int = 1,
@@ -249,6 +369,9 @@ class MasterClient:
         ), msg.ReconnectResult)
         if result.generation:
             self.master_generation = result.generation
+        # a restarted/promoted master's coordination tier is a fresh
+        # bind; adopt whatever it advertises (possibly "")
+        self.set_coord_addr(getattr(result, "coord_addr", ""))
         return result
 
     @retry_rpc()
@@ -304,9 +427,12 @@ class MasterClient:
         (parallel/dcn_sync.py)."""
         import json
 
-        result = self._get_typed(msg.SliceStatusRequest(
-            node_id=self.node_id, node_rank=self.node_rank,
-            rdzv_name=rdzv_name), msg.SliceStatus)
+        # per-step traffic: the coordination tier answers when split out
+        result = self._typed(
+            lambda request: self._coord_send("get", request),
+            msg.SliceStatusRequest(
+                node_id=self.node_id, node_rank=self.node_rank,
+                rdzv_name=rdzv_name), msg.SliceStatus)
         if not result.status_json:
             return {}
         try:
@@ -375,28 +501,44 @@ class MasterClient:
         )
 
     # -- kv store ---------------------------------------------------------
+    # hot-prefix keys (dcn/ gradient exchange, coord/ barriers) route to
+    # the coordination tier when the master split one out; cold keys
+    # stay on the main tier for its write-through snapshot durability
     def kv_set(self, key: str, value: bytes) -> bool:
-        return self._report(msg.KeyValuePair(key=key, value=value)).success
+        request = msg.KeyValuePair(key=key, value=value)
+        if self._is_hot_key(key):
+            return self._coord_send("report", request).success
+        return self._report(request).success
 
     def kv_get(self, key: str) -> bytes:
-        return self._get_typed(msg.KVGetRequest(key=key),
-                               msg.KeyValuePair).value
+        send = ((lambda request: self._coord_send("get", request))
+                if self._is_hot_key(key) else self._get)
+        return self._typed(send, msg.KVGetRequest(key=key),
+                           msg.KeyValuePair).value
 
     def kv_add(self, key: str, amount: int) -> int:
-        return self._report_typed(
-            msg.KVAddRequest(key=key, amount=amount), msg.KVIntResult,
-        ).value
+        send = ((lambda request: self._coord_send("report", request))
+                if self._is_hot_key(key) else self._report)
+        return self._typed(send,
+                           msg.KVAddRequest(key=key, amount=amount),
+                           msg.KVIntResult).value
 
     def kv_wait(self, key: str, timeout_s: float = 300.0) -> bytes:
         """Block until the key appears: the master holds each RPC open on a
         condition variable (KVWaitRequest) for up to ~20 s per window."""
         deadline = time.time() + timeout_s
+        hot = self._is_hot_key(key)
         while True:
             remaining = deadline - time.time()
             if remaining <= 0:
                 raise TimeoutError(f"kv_wait timed out on {key!r}")
-            result = self._get(msg.KVWaitRequest(
-                keys=[key], timeout_s=min(remaining, 20.0)))
+            window = min(remaining, 20.0)
+            request = msg.KVWaitRequest(keys=[key], timeout_s=window)
+            if hot:
+                result = self._coord_send(
+                    "get", request, timeout_s=window + self._timeout_s)
+            else:
+                result = self._get(request)
             if getattr(result, "success", False):
                 return self.kv_get(key)
 

@@ -52,6 +52,24 @@ class Context:
         self.master_snapshot_min_interval_s: float = (
             DefaultValues.MASTER_SNAPSHOT_MIN_INTERVAL_S
         )
+        # sharded control plane (master/rendezvous_shards.py +
+        # master/coord_service.py + master/standby.py): per-slice
+        # rendezvous shards, the KV/coordination tier's own port, the
+        # bounded telemetry ingest, and the hot-standby promoter
+        self.rdzv_sharded: bool = DefaultValues.RDZV_SHARDED
+        self.coord_port: int = DefaultValues.COORD_PORT
+        self.telemetry_queue_size: int = (
+            DefaultValues.TELEMETRY_QUEUE_SIZE
+        )
+        self.kv_gc_keep_generations: int = (
+            DefaultValues.KV_GC_KEEP_GENERATIONS
+        )
+        self.standby_health_interval_s: float = (
+            DefaultValues.STANDBY_HEALTH_INTERVAL_S
+        )
+        self.standby_promote_failures: int = (
+            DefaultValues.STANDBY_PROMOTE_FAILURES
+        )
         self.monitor_interval_s: float = DefaultValues.MONITOR_INTERVAL_S
         self.report_resource_interval_s: float = (
             DefaultValues.REPORT_RESOURCE_INTERVAL_S

@@ -140,6 +140,11 @@ class NodeEnv:
     # into; agents in master-lost mode re-resolve from it (the address of
     # a restarted master usually differs — new pod IP / new free port).
     MASTER_BOOTSTRAP = "DLROVER_TPU_MASTER_BOOTSTRAP_FILE"
+    # Coordination-tier address (master/coord_service.py): hot KV
+    # traffic (dcn/ gradient exchange, coord/ barriers) dials this
+    # instead of the control tier. Set by the agent for its worker from
+    # the join result; "" / unset = single-tier master.
+    COORD_ADDR = "DLROVER_TPU_COORD_ADDR"
     NODE_ID = "DLROVER_TPU_NODE_ID"
     NODE_TYPE = "DLROVER_TPU_NODE_TYPE"
     NODE_RANK = "DLROVER_TPU_NODE_RANK"
@@ -273,6 +278,27 @@ class DefaultValues:
     # dispatch-heavy phases at the cost of up to that much durability
     # lag on a crash (docs/fault_tolerance.md)
     MASTER_SNAPSHOT_MIN_INTERVAL_S = 0.0
+    # -- sharded control plane (master/rendezvous_shards.py) ------------
+    # per-slice rendezvous shards behind a router: a wedged slice's
+    # joins cannot delay another slice's cut, and a shard restarts
+    # alone. False reverts JobMaster to the single-lock manager (the
+    # bench baseline).
+    RDZV_SHARDED = True
+    # the KV/coordination tier's own port (master/coord_service.py):
+    # 0 = any free port, -1 = serve coordination on the main port only
+    COORD_PORT = 0
+    # bounded telemetry ingest: reports queued past this are dropped
+    # oldest-first (dlrover_tpu_telemetry_dropped_total)
+    TELEMETRY_QUEUE_SIZE = 256
+    # kv episode hygiene: generations of a namespaced hot-key group
+    # retained (current + N-1 for in-flight readers of the superseded
+    # episode); older generations are garbage-collected on write
+    KV_GC_KEEP_GENERATIONS = 2
+    # -- hot-standby master (master/standby.py) -------------------------
+    # cadence of the standby's primary health probe, and how many
+    # consecutive failed probes trigger promotion
+    STANDBY_HEALTH_INTERVAL_S = 2.0
+    STANDBY_PROMOTE_FAILURES = 3
     KV_WAIT_TIMEOUT_S = 300.0
     MONITOR_INTERVAL_S = 5.0
     REPORT_RESOURCE_INTERVAL_S = 15.0
@@ -382,3 +408,12 @@ class DefaultValues:
     # code) after this many failures inside the window; 0 disables
     QUARANTINE_FAILURES = 5
     QUARANTINE_WINDOW_S = 600.0
+
+
+# The hot-tier KV contract, shared by the master (snapshot exemption +
+# mutation log + generation GC, master/kv_store.py) and the client
+# (coordination-tier routing, agent/master_client.py): keys under these
+# prefixes are on the gradient path. ONE constant — a prefix added to
+# only one side would silently route hot traffic to the control tier or
+# skip snapshotting a cold key.
+HOT_KV_PREFIXES = ("dcn/", "coord/")

@@ -208,6 +208,10 @@ class JoinRendezvousResult(Message):
     # planner input yet / sender predates the field; workers re-fetch
     # fresh via ShardPlanRequest at loop build.
     shard_plan_json: str = ""
+    # Coordination-tier address (master/coord_service.py): hot KV
+    # traffic (dcn/ gradient exchange, coord/ barriers) dials this
+    # instead of the control tier. "" = tier not split out.
+    coord_addr: str = ""
 
 
 @dataclass
@@ -237,6 +241,10 @@ class ReconnectResult(Message):
     # world moved on (or was never restored); re-join rendezvous.
     world_intact: bool = False
     round: int = -1
+    # the (possibly promoted) master's coordination-tier address; a
+    # standby's tier binds a fresh port, so reconnecting clients must
+    # re-learn it ("" = tier not split out)
+    coord_addr: str = ""
 
 
 @dataclass

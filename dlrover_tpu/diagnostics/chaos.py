@@ -22,7 +22,21 @@ Spec grammar (semicolon-separated faults):
                            step 5 (the servicer feeds worker
                            GlobalStepReports to a master-side injector) —
                            exercises crash-consistent state recovery +
-                           agent reconnection (docs/fault_tolerance.md)
+                           agent reconnection, and (with a hot standby
+                           watching, master/standby.py) the promotion
+                           path (docs/fault_tolerance.md)
+    kill:shard:1@5         kill slice 1's RENDEZVOUS SHARD inside the
+                           master when any worker reports step 5: the
+                           shard actor is rebuilt from its state
+                           partition (rendezvous_shards.py
+                           restart_shard) while every other slice's
+                           shard keeps serving — the shard-scoped
+                           failure-domain drill
+    hang:shard:1@5:3       WEDGE slice 1's rendezvous shard for 3 s at
+                           step 5: its callers stall at the router
+                           boundary; other slices' joins and cuts are
+                           provably unaffected (the regression test in
+                           tests/test_controlplane.py)
     preempt:worker:1@4:20  rank 1 receives an advance PREEMPTION NOTICE
                            at step 4 with a 20 s grace window: the fault
                            atomically writes the notice file the agent's
@@ -206,11 +220,17 @@ class ChaosInjector:
         self._rank = rank
         self._slice = slice_id
         self._state_dir = os.environ.get(CHAOS_STATE_ENV, "")
+        # control-plane shard faults (kill:shard:S / hang:shard:S):
+        # handled by the MASTER-side injector through these hooks
+        # (JobMaster wires them to the sharded rendezvous router)
+        self.shard_kill_fn = None
+        self.shard_wedge_fn = None
         # a "slice"-role fault addresses the SLICE in its rank field:
         # every member of that slice arms it, so kill/preempt fan
         # across the whole failure domain. Resize faults arm on EVERY
         # worker — whether this rank is part of the delta is decided at
-        # fire time against the live world/slice count.
+        # fire time against the live world/slice count. "shard"-role
+        # faults arm on the MASTER (the shard lives in its process).
         self.faults = [
             f for f in parse_chaos(spec)
             if (f.action == "resize" and role == "worker")
@@ -218,6 +238,7 @@ class ChaosInjector:
             or (f.role == "slice" and f.action != "resize"
                 and role == "worker"
                 and slice_id >= 0 and f.rank == slice_id)
+            or (f.role == "shard" and role == "master")
         ] if spec else []
         for fault in self.faults:
             if self._already_fired(fault):
@@ -323,7 +344,9 @@ class ChaosInjector:
         for fault in self.faults:
             if fault.fired or step < fault.at_step:
                 continue
-            if fault.action == "kill":
+            if fault.role == "shard":
+                self._inject_shard_fault(fault, step)
+            elif fault.action == "kill":
                 # record BEFORE dying, or the respawned incarnation
                 # replays the fault forever
                 if not self._record_fired(fault):
@@ -352,6 +375,40 @@ class ChaosInjector:
             elif fault.action == "slow":
                 # applies every step from at_step on (a real straggler)
                 time.sleep(fault.duration)
+
+    def _inject_shard_fault(self, fault: ChaosFault, step: int) -> None:
+        """Shard-scoped control-plane faults, executed through the hooks
+        the master wired in (no-op with a warning when the training
+        manager is not sharded)."""
+        if fault.action == "kill":
+            if not self._record_fired(fault):
+                return
+            if self.shard_kill_fn is None:
+                logger.warning(
+                    "chaos kill:shard:%d armed but no sharded "
+                    "rendezvous manager to kill (rdzv_sharded off?)",
+                    fault.rank)
+                return
+            logger.warning("chaos: killing rendezvous shard %d at "
+                           "step %d", fault.rank, step)
+            self.shard_kill_fn(fault.rank)
+        elif fault.action == "hang":
+            if not self._record_fired(fault):
+                return
+            if self.shard_wedge_fn is None:
+                logger.warning(
+                    "chaos hang:shard:%d armed but no sharded "
+                    "rendezvous manager to wedge (rdzv_sharded off?)",
+                    fault.rank)
+                return
+            logger.warning("chaos: wedging rendezvous shard %d for "
+                           "%.1fs at step %d", fault.rank,
+                           fault.duration, step)
+            self.shard_wedge_fn(fault.rank, fault.duration)
+        else:
+            logger.warning("chaos: unsupported shard fault %s ignored",
+                           fault.action)
+            fault.fired = True
 
     def _inject_resize(self, fault: ChaosFault, step: int) -> None:
         """Deterministic mid-run resize. Scale-DOWN (delta < 0): this

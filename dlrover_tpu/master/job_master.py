@@ -20,12 +20,13 @@ from dlrover_tpu.common.config import Context
 from dlrover_tpu.common.constants import JobStage, NodeType, RendezvousName
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.kv_store import KVStoreService
-from dlrover_tpu.master.state_backend import MasterStateBackend
+from dlrover_tpu.master.state_backend import MasterStateBackend, MutationLog
 from dlrover_tpu.master.rendezvous import (
     ElasticTrainingRendezvousManager,
     NetworkCheckRendezvousManager,
     RendezvousParameters,
 )
+from dlrover_tpu.master.rendezvous_shards import ShardedRendezvousManager
 from dlrover_tpu.master.servicer import MasterServicer
 from dlrover_tpu.master.shard.task_manager import TaskManager
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
@@ -48,6 +49,7 @@ class JobMaster:
         host: str = "0.0.0.0",
         brain_addr: str = "",
         state_dir: Optional[str] = None,
+        preloaded_state: Optional[tuple] = None,
     ):
         ctx = Context.singleton()
         params = RendezvousParameters(
@@ -59,16 +61,24 @@ class JobMaster:
         self.task_manager = TaskManager()
         self.speed_monitor = SpeedMonitor()
         self.task_manager.speed_monitor = self.speed_monitor
+        # sharded by default: per-slice rendezvous shards behind a thin
+        # router, so one slice's join storm (or a wedged shard) can
+        # never delay another slice's cut (rendezvous_shards.py).
+        # rdzv_sharded=False keeps the single-lock manager — the bench
+        # baseline and an escape hatch.
+        training_mgr = (ShardedRendezvousManager(params)
+                        if ctx.rdzv_sharded
+                        else ElasticTrainingRendezvousManager(params))
         self.rdzv_managers = {
-            RendezvousName.TRAINING:
-                ElasticTrainingRendezvousManager(params),
+            RendezvousName.TRAINING: training_mgr,
             RendezvousName.NETWORK_CHECK:
                 NetworkCheckRendezvousManager(
                     RendezvousParameters(min_nodes, max_nodes,
                                          ctx.rdzv_wait_new_node_s)
                 ),
         }
-        self.kv_store = KVStoreService()
+        self.kv_store = KVStoreService(
+            keep_generations=ctx.kv_gc_keep_generations)
         self.sync_service = SyncService(expected_workers=min_nodes)
         self.elastic_ps_service = ElasticPsService()
         self.job_manager = job_manager
@@ -99,6 +109,7 @@ class JobMaster:
             self.servicer.get_bytes, self.servicer.report_bytes,
             port=port, host=host,
         )
+        self._init_coord_tier(host)
         self._stopped = threading.Event()
         self._exit_reason = ""
         self.metric_collector = None
@@ -130,42 +141,105 @@ class JobMaster:
         self._init_state_backend(
             state_dir if state_dir is not None else ctx.master_state_dir,
             ctx.master_snapshot_retain,
+            preloaded_state=preloaded_state,
         )
         self._arm_master_chaos()
 
+    # -- the coordination tier (master/coord_service.py) ----------------
+    def _init_coord_tier(self, host: str) -> None:
+        """Bind the KV/coordination tier on its own port + thread pool
+        so a join/telemetry storm on the control tier can never stall a
+        step's dcn/ exchange (coord_port -1 = single-tier: the main
+        servicer answers everything, as it always has)."""
+        from dlrover_tpu.master.coord_service import CoordServicer
+
+        self._coord_server = None
+        self.coord_port = 0
+        port = Context.singleton().coord_port
+        if port < 0:
+            return
+        self.coord_servicer = CoordServicer(
+            self.kv_store,
+            rdzv_manager=self.rdzv_managers[RendezvousName.TRAINING],
+            speed_monitor=self.speed_monitor)
+        try:
+            # a full-width pool: blocked KVWaits hold threads, and the
+            # tier must keep answering per-step gets through a world
+            # formation's wait pile-up
+            self._coord_server, self.coord_port = build_server(
+                self.coord_servicer.get_bytes,
+                self.coord_servicer.report_bytes,
+                port=port, host=host, max_workers=64)
+        except RuntimeError as e:
+            logger.warning("coordination tier failed to bind: %s "
+                           "(serving coordination on the main port)", e)
+            self._coord_server = None
+            return
+        self.servicer.coord_addr = self.coord_addr
+
     # -- crash-consistent control-plane state --------------------------
-    def _init_state_backend(self, state_dir: str, retain: int) -> None:
+    def _init_state_backend(self, state_dir: str, retain: int,
+                            preloaded_state: Optional[tuple] = None
+                            ) -> None:
         """Attach the snapshot store and, when a prior master left valid
         state behind, rebuild every manager from it BEFORE serving. The
         generation token bumps once per (re)start over one state lineage
         so reconnecting agents can tell a restarted master from a
-        transient outage."""
+        transient outage. ``preloaded_state`` is the hot standby's warm
+        copy — promotion skips the disk read it already did.
+
+        The hot-key mutation log is replayed OVER the snapshot: the
+        dcn/ and coord/ keys deliberately do not trigger snapshots, so
+        their last values live in the log (state_backend.MutationLog)."""
         self._snapshot_lock = threading.Lock()
         self._state_backend = None
+        self._mutation_log = None
         self._last_snapshot_ts = 0.0
+        # double-primary fencing extends to the STATE DIR: once a
+        # higher-generation master owns the bootstrap file, this one
+        # must stop writing snapshots + mutation-log appends into the
+        # shared lineage (interleaved writers would corrupt the log and
+        # let a stale later-versioned snapshot win the next restore)
         with self._snapshot_lock:
+            self._fenced = False
+            self._last_fence_check = 0.0
             self._snapshot_timer: Optional[threading.Timer] = None
         self.generation = 0
         if state_dir:
             self._state_backend = MasterStateBackend(state_dir,
                                                      retain=retain)
             self.generation = 1
-            loaded = self._state_backend.load_latest()
+            loaded = (preloaded_state if preloaded_state is not None
+                      else self._state_backend.load_latest())
             if loaded is not None:
                 state, version = loaded
                 with obs.span("master_restore",
-                              {"snapshot_version": version}):
+                              {"snapshot_version": version,
+                               "preloaded": preloaded_state
+                               is not None}):
                     self._restore_state(state)
+                    replayed = self.kv_store.replay_mutations(
+                        MutationLog.read(state_dir))
                 logger.info(
                     "master state restored from snapshot v%d "
-                    "(generation %d)", version, self.generation)
+                    "(generation %d, %d hot mutations replayed)",
+                    version, self.generation, replayed)
                 obs.get_flight_recorder().record_event(
                     "master_restore", snapshot_version=version,
-                    generation=self.generation)
+                    generation=self.generation,
+                    hot_mutations_replayed=replayed)
                 obs.get_registry().counter(
                     "dlrover_tpu_master_restores_total",
                     "Masters rebuilt from a state snapshot").inc()
+            self._mutation_log = MutationLog(state_dir)
+            # the drainer consults the fence before every write: hot-
+            # only traffic (which never snapshots) must still stop the
+            # moment a higher-generation master owns the lineage
+            self._mutation_log.gate = self._check_fenced
+            self.kv_store.attach_mutation_log(self._mutation_log)
             self.servicer.state_sink = self._maybe_snapshot
+            if self._coord_server is not None:
+                self.coord_servicer.state_sink = self._maybe_snapshot
             if self.diagnosis_manager is not None:
                 self.diagnosis_manager.state_sink = self._maybe_snapshot
             # the generation bump itself must be durable before the
@@ -222,6 +296,8 @@ class JobMaster:
         The default (0) is strict write-through."""
         if self._state_backend is None:
             return
+        if self._check_fenced():
+            return
         interval = Context.singleton().master_snapshot_min_interval_s
         with self._snapshot_lock:
             remaining = self._last_snapshot_ts + interval - time.time()
@@ -241,6 +317,10 @@ class JobMaster:
                 return
             if written is not None:
                 self._last_snapshot_ts = time.time()
+                if self._mutation_log is not None:
+                    # the snapshot's kv export includes the hot keys at
+                    # this instant: every logged mutation is now durable
+                    self._mutation_log.rotate()
 
     def _trailing_snapshot(self) -> None:
         """Timer body: flush the mutation that fell inside the
@@ -249,12 +329,76 @@ class JobMaster:
             self._snapshot_timer = None
         self._maybe_snapshot(force=True)
 
+    @staticmethod
+    def _bootstrap_file_generation() -> int:
+        """The generation the bootstrap file currently carries (-1 =
+        no file / pre-JSON / unreadable). One parser for the whole
+        contract: the same ``resolve_bootstrap`` agents re-resolve
+        through (env override included)."""
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        try:
+            return int(MasterClient.resolve_bootstrap().get(
+                "generation", -1))
+        except (TypeError, ValueError):
+            return -1
+
+    def _check_fenced(self, throttle_s: float = 2.0) -> bool:
+        """Has a higher-generation master taken over the lineage? Read
+        the bootstrap file at most once per ``throttle_s``; on the
+        first detection, STOP this master's state writes for good —
+        snapshots AND hot-key mutation-log appends — so the promoted
+        primary's lineage can never be clobbered by a stale writer
+        (e.g. a network-blip promotion while this one is still
+        alive)."""
+        with self._snapshot_lock:
+            if self._fenced:
+                return True
+            now = time.time()
+            if now - self._last_fence_check < throttle_s:
+                return False
+            self._last_fence_check = now
+        file_gen = self._bootstrap_file_generation()
+        if not self.generation or file_gen <= self.generation:
+            return False
+        self._mark_fenced(file_gen)
+        return True
+
+    def _mark_fenced(self, file_generation: int) -> None:
+        with self._snapshot_lock:
+            if self._fenced:
+                return
+            self._fenced = True
+        # stop NEW appends; already-queued entries are discarded by the
+        # drainer's gate (this method may BE on the drainer thread via
+        # that gate, so closing the log here would self-join)
+        self.kv_store.attach_mutation_log(None)
+        logger.critical(
+            "FENCED: generation %d owns the bootstrap file (ours is "
+            "%d) — another master promoted past us; stopping every "
+            "state write into the shared lineage", file_generation,
+            self.generation)
+        obs.get_flight_recorder().record_event(
+            "master_fenced", file_generation=file_generation,
+            our_generation=self.generation)
+        obs.get_registry().counter(
+            "dlrover_tpu_master_fenced_total",
+            "Bootstrap publishes refused because a higher-generation "
+            "master already owns the file").inc()
+
     def _arm_master_chaos(self) -> None:
         """kill:master:0@step — fed from worker GlobalStepReports so a
-        chaos run can assassinate the control plane at a chosen step."""
+        chaos run can assassinate the control plane at a chosen step —
+        plus the shard-scoped faults: kill:shard:S@step restarts slice
+        S's rendezvous shard from its state partition, hang:shard:S@step
+        wedges it (every other shard provably keeps serving)."""
         from dlrover_tpu.diagnostics.chaos import ChaosInjector
 
         chaos = ChaosInjector(role=NodeType.MASTER, rank=0)
+        training = self.rdzv_managers[RendezvousName.TRAINING]
+        if hasattr(training, "restart_shard"):
+            chaos.shard_kill_fn = training.restart_shard
+            chaos.shard_wedge_fn = training.wedge_shard
         if chaos.faults:
             self.servicer.master_chaos = chaos
 
@@ -313,6 +457,10 @@ class JobMaster:
     # ------------------------------------------------------------------
     def prepare(self) -> None:
         self._server.start()
+        if self._coord_server is not None:
+            self._coord_server.start()
+            logger.info("coordination tier serving on port %d",
+                        self.coord_port)
         if self.job_manager is not None:
             self.job_manager.start()
         if self.metric_collector is not None:
@@ -329,9 +477,19 @@ class JobMaster:
         logger.info("job master serving on port %d", self.port)
 
     def _publish_bootstrap_addr(self) -> None:
-        """Atomically write the advertised address to the bootstrap file
-        so agents in master-lost mode can re-resolve a restarted master
-        (whose port/IP usually changed)."""
+        """Atomically write the advertised addresses + generation token
+        to the bootstrap file (JSON since the hot-standby work; plain
+        pre-JSON files are still read by resolve_bootstrap) so agents in
+        master-lost mode can re-resolve a restarted OR promoted master.
+
+        Generation fencing: a file already carrying a HIGHER generation
+        is never overwritten — a revived old primary coming back after a
+        standby promoted must not steal the fleet back (double-primary
+        split-brain). The fenced master logs CRITICAL and keeps serving
+        whoever still dials its old address; agents re-resolve to the
+        higher generation."""
+        import json
+
         path = Context.singleton().master_bootstrap_file
         if not path:
             return
@@ -339,15 +497,64 @@ class JobMaster:
             parent = os.path.dirname(path)
             if parent:
                 os.makedirs(parent, exist_ok=True)
-            tmp = f"{path}.{os.getpid()}.tmp"
-            with open(tmp, "w") as f:
-                f.write(self.addr)
-            os.replace(tmp, path)
+            # the read-check-replace must be one critical section: two
+            # masters racing it bare could interleave so the LOWER
+            # generation's replace lands last and permanently points
+            # the fleet at the stale primary. Advisory flock on a
+            # sidecar serializes every publisher using this code.
+            with self._bootstrap_publish_lock(path):
+                current_gen = self._bootstrap_file_generation()
+                if self.generation and current_gen > self.generation:
+                    # fencing covers the whole lineage, not just the
+                    # file: this master also stops snapshot/mutation-
+                    # log writes
+                    self._mark_fenced(current_gen)
+                    return
+                tmp = f"{path}.{os.getpid()}.tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"addr": self.addr,
+                               "coord_addr": self.coord_addr,
+                               "generation": self.generation}, f)
+                os.replace(tmp, path)
         except OSError as e:
             logger.warning("cannot publish master address to %s: %s",
                            path, e)
             return
-        logger.info("master address %s published to %s", self.addr, path)
+        logger.info("master address %s (coord %s, generation %d) "
+                    "published to %s", self.addr,
+                    self.coord_addr or "-", self.generation, path)
+
+    @staticmethod
+    def _bootstrap_publish_lock(path: str):
+        """Advisory exclusive lock over the bootstrap publish critical
+        section (best-effort: a filesystem without flock degrades to
+        the bare race, which is still bounded by the fence check)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def held():
+            lock_file = None
+            try:
+                import fcntl
+
+                lock_file = open(f"{path}.lock", "w")
+                fcntl.flock(lock_file, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                # acquisition failure only — a body exception must
+                # never land here (it would make the manager re-yield)
+                if lock_file is not None:
+                    lock_file.close()
+                lock_file = None
+            try:
+                yield
+            finally:
+                if lock_file is not None:
+                    try:
+                        fcntl.flock(lock_file, fcntl.LOCK_UN)
+                        lock_file.close()
+                    except OSError:
+                        pass
+        return held()
 
     def _start_metrics_exporter(self) -> None:
         """Serve the Prometheus exposition (metrics_port: 0 = any free
@@ -421,9 +628,16 @@ class JobMaster:
                 if self._snapshot_timer is not None:
                     self._snapshot_timer.cancel()
                     self._snapshot_timer = None
+            # queued telemetry is replayed before the final flight dump
+            # (a graceful stop must not silently drop spans), then the
+            # drainer stops
+            self.servicer.telemetry_queue.flush(timeout_s=2.0)
+            self.servicer.telemetry_queue.stop()
             # a coalesced mutation must not die with the process when
             # the stop is graceful
             self._maybe_snapshot(force=True)
+            if self._mutation_log is not None:
+                self._mutation_log.close()
             # the master's half of the postmortem timeline; the goodput
             # snapshot rides in the dump so `tools/goodput.py --flight`
             # renders the ledger from the postmortem alone
@@ -432,6 +646,8 @@ class JobMaster:
             obs.get_flight_recorder().record_event(
                 "master_stop", exit_reason=self._exit_reason)
             obs.get_flight_recorder().dump(reason="master-stop")
+            if self._coord_server is not None:
+                self._coord_server.stop(grace_s)
             self._server.stop(grace_s)
 
     @property
@@ -439,12 +655,23 @@ class JobMaster:
         """Address agents should dial. A 0.0.0.0 bind is advertised as the
         host's routable IP so multi-host agents don't dial their own
         loopback."""
+        return f"{self._advertised_host()}:{self.port}"
+
+    @property
+    def coord_addr(self) -> str:
+        """The coordination tier's advertised address ("" = single-tier:
+        coordination served on the main port)."""
+        if self._coord_server is None:
+            return ""
+        return f"{self._advertised_host()}:{self.coord_port}"
+
+    def _advertised_host(self) -> str:
         from dlrover_tpu.common.comm import local_ip
 
         host = self._host
         if host in ("0.0.0.0", "::", ""):
             host = local_ip()
-        return f"{host}:{self.port}"
+        return host
 
 
 def run_master_main(args=None) -> int:
@@ -483,10 +710,27 @@ def run_master_main(args=None) -> int:
                         help="file the master atomically writes its "
                              "advertised address into; agents re-resolve "
                              "from it after a master restart")
+    parser.add_argument("--standby", action="store_true",
+                        help="run as a HOT STANDBY instead of the "
+                             "primary: tail the primary's snapshot "
+                             "stream under --state-dir, health-check "
+                             "the address it publishes in "
+                             "--bootstrap-file, and promote (serve from "
+                             "warm state, bumped generation, no worker "
+                             "restarts) when it stops answering")
     ns = parser.parse_args(args)
     Context.singleton().update(metrics_port=ns.metrics_port,
                                master_state_dir=ns.state_dir,
                                master_bootstrap_file=ns.bootstrap_file)
+    if ns.standby:
+        from dlrover_tpu.master.standby import StandbyMaster
+
+        standby = StandbyMaster(
+            state_dir=ns.state_dir, bootstrap_file=ns.bootstrap_file,
+            port=ns.port, min_nodes=ns.min_nodes,
+            max_nodes=ns.max_nodes, node_unit=ns.node_unit)
+        print("DLROVER_TPU_STANDBY=watching", flush=True)
+        return standby.run()
     if ns.platform == "k8s":
         from dlrover_tpu.operator.crd import (
             ELASTICJOB_PLURAL,

@@ -45,6 +45,70 @@ class _WaitingNode:
     join_time: float = field(default_factory=time.time)
 
 
+def plan_restore_entries(stores: Dict[int, Dict], node_rank: int,
+                         slices: Dict[int, int],
+                         stripe: bool = False) -> Dict:
+    """The pure donor-selection core of ``compute_restore_plan``:
+    ``stores`` must already be filtered to alive, non-draining donors.
+    Shared by the single-lock manager (which calls it under its lock)
+    and the sharded router (which calls it with aggregated copies —
+    master/rendezvous_shards.py). Returns {"step", "entries", "donors"}
+    (epoch stamping is the caller's)."""
+    if not stores:
+        return {"step": -1, "entries": {}, "donors": {}}
+    step = max(store["step"] for store in stores.values())
+    at_step = {rank: store for rank, store in stores.items()
+               if store["step"] == step}
+    requester_slice = slices.get(node_rank, -1)
+    holders: Dict[str, List[int]] = {}
+    for rank in sorted(at_step):
+        for key in at_step[rank]["keys"]:
+            holders.setdefault(key, []).append(rank)
+    entries: Dict[str, Dict] = {}
+    # independent round-robin cursors per tier, so the ICI tier
+    # spreads across same-slice donors and the DCN tier across the
+    # rest — one shared cursor would skew whichever tier the other
+    # consumed from
+    spread_same = 0
+    spread_cross = 0
+    for key in sorted(holders):
+        ranks = holders[key]
+        if node_rank in ranks:
+            donor, tier = node_rank, "local"
+        elif stripe and len(ranks) > 1:
+            # resharding migration: order every holder same-slice
+            # first, then the rest — the receiver stripes the shard's
+            # bytes across them in parallel
+            same = [r for r in ranks
+                    if requester_slice >= 0
+                    and slices.get(r, -1) == requester_slice]
+            ordered = same + [r for r in ranks if r not in same]
+            entries[key] = {
+                "ranks": ordered,
+                "addrs": [at_step[r]["addr"] for r in ordered],
+                "tier": "striped"}
+            continue
+        else:
+            same = [r for r in ranks
+                    if requester_slice >= 0
+                    and slices.get(r, -1) == requester_slice]
+            if same:
+                donor = same[spread_same % len(same)]
+                spread_same += 1
+                tier = "same-slice"
+            else:
+                donor = ranks[spread_cross % len(ranks)]
+                spread_cross += 1
+                tier = "cross-slice"
+        entries[key] = {"rank": donor,
+                        "addr": at_step[donor]["addr"],
+                        "tier": tier}
+    return {
+        "step": step, "entries": entries,
+        "donors": {rank: at_step[rank]["addr"] for rank in at_step},
+    }
+
+
 class RendezvousManager:
     """Base rendezvous: collect joiners, cut a round when complete.
 
@@ -226,7 +290,11 @@ class RendezvousManager:
                     "draining": any(r in self._draining
                                     for r in members),
                 }
-            return {"total": len(sids), "slices": slices}
+            # the world epoch namespaces the hot dcn/ coordination keys
+            # (parallel/dcn_sync.py + kv_store episode hygiene): every
+            # membership loss moves the fleet to a fresh key namespace
+            return {"total": len(sids), "slices": slices,
+                    "epoch": self._world_epoch}
 
     def world_for(self, node_rank: int) -> Dict[int, int]:
         """The world ``node_rank`` belongs to: its slice's world in
@@ -575,67 +643,25 @@ class RendezvousManager:
                 if rank in self._alive_nodes
                 and rank not in self._draining
             }
-            epoch = self._world_epoch
-            if not stores:
-                return {"epoch": epoch, "step": -1, "entries": {},
-                        "donors": {}}
-            step = max(store["step"] for store in stores.values())
-            at_step = {rank: store for rank, store in stores.items()
-                       if store["step"] == step}
-            requester_slice = self._slices.get(node_rank, -1)
-            holders: Dict[str, List[int]] = {}
-            for rank in sorted(at_step):
-                for key in at_step[rank]["keys"]:
-                    holders.setdefault(key, []).append(rank)
-            entries: Dict[str, Dict] = {}
-            # independent round-robin cursors per tier, so the ICI tier
-            # spreads across same-slice donors and the DCN tier across
-            # the rest — one shared cursor would skew whichever tier
-            # the other consumed from
-            spread_same = 0
-            spread_cross = 0
-            for key in sorted(holders):
-                ranks = holders[key]
-                if node_rank in ranks:
-                    donor, tier = node_rank, "local"
-                elif stripe and len(ranks) > 1:
-                    # resharding migration: order every holder
-                    # same-slice first, then the rest — the receiver
-                    # stripes the shard's bytes across them in parallel
-                    same = [r for r in ranks
-                            if requester_slice >= 0
-                            and self._slices.get(r, -1)
-                            == requester_slice]
-                    ordered = same + [r for r in ranks if r not in same]
-                    entries[key] = {
-                        "ranks": ordered,
-                        "addrs": [at_step[r]["addr"] for r in ordered],
-                        "tier": "striped"}
-                    continue
-                else:
-                    same = [r for r in ranks
-                            if requester_slice >= 0
-                            and self._slices.get(r, -1)
-                            == requester_slice]
-                    if same:
-                        donor = same[spread_same % len(same)]
-                        spread_same += 1
-                        tier = "same-slice"
-                    else:
-                        donor = ranks[spread_cross % len(ranks)]
-                        spread_cross += 1
-                        tier = "cross-slice"
-                entries[key] = {"rank": donor,
-                                "addr": at_step[donor]["addr"],
-                                "tier": tier}
-            plan = {
-                "epoch": epoch, "step": step, "entries": entries,
-                "donors": {rank: at_step[rank]["addr"]
-                           for rank in at_step},
-            }
+            plan = plan_restore_entries(stores, node_rank, self._slices,
+                                        stripe=stripe)
+            plan["epoch"] = self._world_epoch
             if stripe:
                 plan["mode"] = "stripe"
             return plan
+
+    def export_protocol_view(self) -> Dict:
+        """One-lock-cut view of the protocol membership (the sharded
+        router aggregates these per shard for fleet-wide planning —
+        master/rendezvous_shards.py)."""
+        with self._lock:
+            return {
+                "world": dict(self._latest_world),
+                "waiting": {r: w.local_world_size
+                            for r, w in self._waiting.items()},
+                "alive": set(self._alive_nodes),
+                "draining": dict(self._draining),
+            }
 
     def reap_dead_nodes(self, timeout_s: float) -> None:
         """Declare ranks silent for > timeout_s dead (world invalidation
@@ -742,14 +768,21 @@ class RendezvousManager:
                 labelnames=("rdzv",),
             ).labels(rdzv=self.name).inc()
         if invalidated_round is not None:
-            obs.get_flight_recorder().record_event(
-                "world_invalidated", rdzv=self.name,
-                dead_rank=node_rank, round=invalidated_round)
-            obs.get_registry().counter(
-                "dlrover_tpu_rendezvous_world_invalidations_total",
-                "Cut worlds invalidated by a member death",
-                labelnames=("rdzv",),
-            ).labels(rdzv=self.name).inc()
+            self._emit_invalidation_obs(node_rank, invalidated_round)
+
+    def _emit_invalidation_obs(self, node_rank: int,
+                               invalidated_round: int) -> None:
+        """Flight + metrics for an invalidated cut world (called OUTSIDE
+        the manager lock; shard inners override it to emit the
+        slice-labeled variant — master/rendezvous_shards.py)."""
+        obs.get_flight_recorder().record_event(
+            "world_invalidated", rdzv=self.name,
+            dead_rank=node_rank, round=invalidated_round)
+        obs.get_registry().counter(
+            "dlrover_tpu_rendezvous_world_invalidations_total",
+            "Cut worlds invalidated by a member death",
+            labelnames=("rdzv",),
+        ).labels(rdzv=self.name).inc()
 
     def _on_world_invalidated(self) -> None:
         """Hook for subclasses holding state keyed on the cut world
@@ -912,8 +945,8 @@ class RendezvousManager:
 
     def _cut_round(self):
         """Select the world for this round (lock held). Returns
-        (duration_s, round_idx, world_size) for the caller to pass to
-        `_emit_round_obs` once the lock is released."""
+        (duration_s, round_idx, world_size, world_ranks) for the caller
+        to pass to `_emit_round_obs` once the lock is released."""
         size = self._rounded_size(
             min(len(self._waiting), self._params.max_nodes)
         )
@@ -938,13 +971,14 @@ class RendezvousManager:
             # will never fire for it, so the next round's span/grace
             # window must not be timed from the OLD round's first join)
             self._latest_round_start = time.time()
-        return duration, self._rdzv_round - 1, len(self._latest_world)
+        return (duration, self._rdzv_round - 1, len(self._latest_world),
+                sorted(self._latest_world))
 
     def _emit_round_obs(self, cut_info) -> None:
         """Round span + counters for a just-cut round. Called AFTER the
         manager lock is released — span sinks and registry children take
         their own locks and must never nest under it."""
-        duration_s, round_idx, world_size = cut_info
+        duration_s, round_idx, world_size, _ = cut_info
         obs.record_span(
             "rendezvous_round", duration_s,
             attrs={"rdzv": self.name, "round": round_idx,
@@ -1038,6 +1072,16 @@ class RendezvousManager:
         """Subclass hook appending extra exported fields (lock held)."""
 
     def restore_state(self, state: dict) -> None:
+        if "shards" in state:
+            # a SHARDED master wrote this lineage; flatten its per-shard
+            # partitions instead of silently restoring an empty protocol
+            # state (the rdzv_sharded=0 escape hatch must keep working
+            # over an existing sharded state-dir)
+            from dlrover_tpu.master.rendezvous_shards import (
+                flatten_sharded_state,
+            )
+
+            state = flatten_sharded_state(state)
         now = time.time()
         with self._lock:
             self._rdzv_round = int(state.get("round", 0))

@@ -1,0 +1,815 @@
+"""Sharded rendezvous control plane: one shard per ICI slice.
+
+PR 8 made the slice the FAILURE domain — per-slice worlds, rounds and
+generation tokens — but every slice still serialized on one manager lock:
+a wedged or slow slice's joins delayed every other slice's cut, and the
+whole registry was one restartable unit. This module makes the slice the
+CONCURRENCY and RESTART domain too:
+
+- :class:`RendezvousShard` — one slice's rendezvous state machine. The
+  inner manager is a plain (sliceless) ``ElasticTrainingRendezvousManager``
+  with its OWN lock and its own partition in the state snapshot; a slice's
+  protocol traffic (join / comm-world / waiting / reap) never touches
+  another shard's lock. A shard can be wedged (chaos ``hang:shard:S``) and
+  restarted alone (``kill:shard:S``) — rebuilt from its exported partition
+  while every other slice keeps cutting.
+- :class:`ShardedRendezvousManager` — the thin router the servicer talks
+  to. Drop-in for ``ElasticTrainingRendezvousManager`` (same surface, same
+  per-slice semantics, same flight events), routing each rank's calls to
+  its slice's shard via a rank→slice map. Fleet-wide coordination state
+  that is NOT per-slice (peer-store donor registry, the parallelism
+  planner profile + memo, the world epoch) lives at router level under a
+  separate lock, gathered from shards WITHOUT nesting locks (router code
+  may take one shard lock at a time; shard code never takes the router
+  lock).
+
+Sliceless jobs route everything to the FLEET shard (slice id -1), whose
+inner manager runs the job's real rendezvous parameters — single-slice
+behavior is byte-identical to the single-lock manager.
+
+``bench_controlplane.py`` measures the win: joins/s and per-slice
+time-to-reform against the single-lock baseline at 1k simulated ranks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu import obs
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+    RendezvousParameters,
+    plan_restore_entries,
+)
+
+FLEET_SHARD = -1
+
+
+def flatten_sharded_state(state: Dict) -> Dict:
+    """Downgrade a SHARDED snapshot ({"shards": {sid: partition}}) into
+    the single-lock manager's flat format, so the documented
+    ``rdzv_sharded=0`` escape hatch (and any pre-split master binary)
+    can take over a sharded lineage instead of silently restoring an
+    empty protocol state. The inverse of ``_restore_legacy``."""
+    shards = state.get("shards") or {}
+    fleet = shards.get(str(FLEET_SHARD), {})
+    flat: Dict = {
+        "round": fleet.get("round", 0),
+        "latest_world": dict(fleet.get("latest_world") or {}),
+        "waiting": dict(fleet.get("waiting") or {}),
+        "alive": list(fleet.get("alive") or ()),
+        "pending_rejoin": list(fleet.get("pending_rejoin") or ()),
+        "node_ips": dict(fleet.get("node_ips") or {}),
+        "draining": dict(fleet.get("draining") or {}),
+        "world_epoch": int(state.get("world_epoch", 0)),
+        "slices": dict(state.get("slices") or {}),
+        "slice_worlds": {},
+        "slice_rounds": {},
+        "slice_generation": {},
+        "peer_stores": dict(state.get("peer_stores") or {}),
+        "known_chips": dict(state.get("known_chips") or {}),
+        "model_profile": dict(state.get("model_profile") or {}),
+        "chip_hbm_bytes": int(state.get("chip_hbm_bytes", 0)),
+        "last_plan": state.get("last_plan"),
+    }
+    for sid_raw, partition in shards.items():
+        sid = int(sid_raw)
+        if sid == FLEET_SHARD:
+            continue
+        flat["slice_worlds"][sid_raw] = dict(
+            partition.get("latest_world") or {})
+        flat["slice_rounds"][sid_raw] = partition.get("round", 0)
+        # shard round doubles as the slice generation (each cut bumps
+        # both in either manager)
+        flat["slice_generation"][sid_raw] = partition.get("round", 0)
+        flat["waiting"].update(partition.get("waiting") or {})
+        flat["alive"] = sorted(
+            {int(r) for r in flat["alive"]}
+            | {int(r) for r in partition.get("alive") or ()})
+        flat["pending_rejoin"] = sorted(
+            {int(r) for r in flat["pending_rejoin"]}
+            | {int(r) for r in partition.get("pending_rejoin") or ()})
+        flat["node_ips"].update(partition.get("node_ips") or {})
+        flat["draining"].update(partition.get("draining") or {})
+    return flat
+
+
+class _ShardInner(ElasticTrainingRendezvousManager):
+    """The per-slice state machine: a plain sliceless manager that emits
+    the SLICE-labeled observability its single-lock predecessor emitted
+    from its slice-mode paths (the e2e evidence — ``slice_world_cut`` /
+    ``slice_world_invalidated`` events, per-slice generation gauges —
+    must not change shape when the control plane shards)."""
+
+    def __init__(self, sid: int,
+                 params: Optional[RendezvousParameters] = None):
+        super().__init__(params)
+        self.sid = sid
+
+    def _emit_round_obs(self, cut_info) -> None:
+        if self.sid == FLEET_SHARD:
+            super()._emit_round_obs(cut_info)
+            return
+        duration_s, round_idx, world_size, world_ranks = cut_info
+        generation = round_idx + 1
+        obs.get_flight_recorder().record_event(
+            "slice_world_cut", rdzv=self.name, slice=self.sid,
+            round=round_idx, generation=generation, world=world_ranks)
+        obs.record_span(
+            "rendezvous_round", duration_s,
+            attrs={"rdzv": self.name, "round": round_idx,
+                   "slice": self.sid, "world_size": world_size})
+        registry = obs.get_registry()
+        registry.counter(
+            "dlrover_tpu_rendezvous_rounds_total",
+            "Completed rendezvous rounds", labelnames=("rdzv",),
+        ).labels(rdzv=self.name).inc()
+        registry.gauge(
+            "dlrover_tpu_slice_generation",
+            "Per-slice generation token: bumped each time THAT slice's "
+            "world re-forms (a peer slice's failure must not move it)",
+            labelnames=("slice",)).labels(
+                slice=str(self.sid)).set(generation)
+        registry.gauge(
+            "dlrover_tpu_slice_world_size",
+            "Node count of the slice's latest cut world",
+            labelnames=("slice",)).labels(
+                slice=str(self.sid)).set(world_size)
+
+    def _emit_invalidation_obs(self, node_rank: int,
+                               invalidated_round: int) -> None:
+        if self.sid == FLEET_SHARD:
+            super()._emit_invalidation_obs(node_rank, invalidated_round)
+            return
+        obs.get_flight_recorder().record_event(
+            "slice_world_invalidated", rdzv=self.name, slice=self.sid,
+            dead_rank=node_rank, round=invalidated_round)
+        obs.get_registry().counter(
+            "dlrover_tpu_rendezvous_world_invalidations_total",
+            "Cut worlds invalidated by a member death",
+            labelnames=("rdzv",),
+        ).labels(rdzv=self.name).inc()
+
+
+class RendezvousShard:
+    """One shard: the inner state machine plus the actor-style controls
+    (wedge for chaos, restart-from-partition for isolation drills)."""
+
+    def __init__(self, sid: int, params: RendezvousParameters):
+        self.sid = sid
+        self._params = params
+        self.inner = _ShardInner(sid, params)
+        self.restarts = 0
+        # monotonic deadline until which every routed call stalls at the
+        # router boundary (the chaos "wedged shard": its callers block,
+        # its lock does NOT — other shards are provably unaffected)
+        self._wedge_until = 0.0
+
+    def wedge(self, seconds: float) -> None:
+        self._wedge_until = time.monotonic() + max(0.0, seconds)
+        logger.warning("rendezvous shard %d WEDGED for %.1fs",
+                       self.sid, seconds)
+        obs.get_flight_recorder().record_event(
+            "shard_wedged", slice=self.sid, seconds=seconds)
+
+    @property
+    def wedged(self) -> bool:
+        return time.monotonic() < self._wedge_until
+
+    def enter(self) -> None:
+        """Stall while wedged. Deliberately sleeps OUTSIDE every lock:
+        the caller's RPC thread blocks (that is the fault being
+        simulated), never the shard's state."""
+        while True:
+            remaining = self._wedge_until - time.monotonic()
+            if remaining <= 0:
+                return
+            time.sleep(min(remaining, 0.05))
+
+    def restart(self, from_state: Optional[dict] = None) -> None:
+        """Kill and rebuild the shard's state machine from its partition
+        (``from_state`` = the state-backend partition when the old actor
+        is unexportable; default = live export). Exactly what
+        ``kill:shard:S`` chaos drives — every other shard keeps serving
+        throughout."""
+        state = from_state if from_state is not None \
+            else self.inner.export_state()
+        replacement = _ShardInner(self.sid, self._params)
+        replacement.restore_state(state)
+        self.inner = replacement
+        self._wedge_until = 0.0
+        self.restarts += 1
+        logger.warning("rendezvous shard %d restarted (restart #%d)",
+                       self.sid, self.restarts)
+        obs.get_flight_recorder().record_event(
+            "shard_restarted", slice=self.sid, restarts=self.restarts)
+        obs.get_registry().counter(
+            "dlrover_tpu_rendezvous_shard_restarts_total",
+            "Rendezvous shards killed and rebuilt from their state "
+            "partition", labelnames=("slice",),
+        ).labels(slice=str(self.sid)).inc()
+
+
+class ShardedRendezvousManager:
+    """The router. Public surface mirrors
+    ``ElasticTrainingRendezvousManager`` so the servicer, the drain/
+    reconnect handlers, event callbacks and the state backend are
+    agnostic to which one serves."""
+
+    name = "elastic-training"
+    slice_scoped = True
+
+    def __init__(self, params: Optional[RendezvousParameters] = None):
+        self._params = params or RendezvousParameters()
+        self._lock = threading.Lock()
+        self._slices: Dict[int, int] = {}
+        self._shards: Dict[int, RendezvousShard] = {
+            FLEET_SHARD: RendezvousShard(FLEET_SHARD, self._params)}
+        self._mutations = 0
+        # the fleet-wide membership-loss clock: router base + the sum of
+        # per-shard epochs (any shard's loss moves the fleet epoch)
+        self._epoch_base = 0
+        # fleet-wide coordination state (deliberately NOT in any shard:
+        # restore plans and parallelism plans span slices)
+        self._peer_stores: Dict[int, Dict] = {}
+        self._known_chips: Dict[int, int] = {}
+        self._model_profile: Dict[str, float] = {}
+        self._chip_hbm_bytes = 0
+        self._last_plan: Optional[Dict] = None
+        self._last_plan_inputs: Optional[Tuple] = None
+
+    # -- routing ----------------------------------------------------------
+    def _slice_params(self) -> RendezvousParameters:
+        """Per-slice shards: a slice cuts when every alive member joined
+        (or the grace expires) — min 1, no node_unit rounding (a slice
+        cuts whole; that is the failure-domain contract)."""
+        return RendezvousParameters(
+            min_nodes=1, max_nodes=self._params.max_nodes,
+            wait_new_node_s=self._params.wait_new_node_s, node_unit=1)
+
+    def _ensure_shard_locked(self, sid: int) -> RendezvousShard:
+        """(lock held)"""
+        shard = self._shards.get(sid)
+        if shard is None:
+            shard = RendezvousShard(sid, self._slice_params())
+            self._shards[sid] = shard
+            self._mutations += 1
+        return shard
+
+    def _shard_for(self, node_rank: int) -> RendezvousShard:
+        with self._lock:
+            sid = self._slices.get(node_rank, FLEET_SHARD)
+            return self._ensure_shard_locked(sid)
+
+    def shard(self, sid: int) -> Optional[RendezvousShard]:
+        with self._lock:
+            return self._shards.get(sid)
+
+    def _all_shards(self) -> List[RendezvousShard]:
+        with self._lock:
+            return list(self._shards.values())
+
+    def _slice_shards(self) -> Dict[int, RendezvousShard]:
+        with self._lock:
+            return {sid: shard for sid, shard in self._shards.items()
+                    if sid != FLEET_SHARD}
+
+    # -- shard lifecycle (chaos + isolation drills) -----------------------
+    def restart_shard(self, sid: int,
+                      from_state: Optional[dict] = None) -> bool:
+        shard = self.shard(sid)
+        if shard is None:
+            logger.warning("restart_shard: no shard %d", sid)
+            return False
+        shard.restart(from_state)
+        with self._lock:
+            self._mutations += 1
+        return True
+
+    def wedge_shard(self, sid: int, seconds: float) -> bool:
+        shard = self.shard(sid)
+        if shard is None:
+            return False
+        shard.wedge(seconds)
+        return True
+
+    def shards_info(self) -> Dict[int, Dict]:
+        """Topology snapshot for tools/diagnose.py + the flight dump."""
+        info: Dict[int, Dict] = {}
+        for shard in self._all_shards():
+            world = shard.inner.latest_world
+            info[shard.sid] = {
+                "round": shard.inner.rdzv_round,
+                "world": sorted(world),
+                "alive": sorted(shard.inner.alive_nodes),
+                "restarts": shard.restarts,
+                "wedged": shard.wedged,
+            }
+        return info
+
+    # -- membership --------------------------------------------------------
+    def update_rdzv_params(self, min_nodes: int, max_nodes: int,
+                           wait_new_node_s: float = 30.0,
+                           node_unit: int = 1) -> None:
+        with self._lock:
+            self._params = RendezvousParameters(
+                min_nodes, max_nodes, wait_new_node_s, node_unit)
+        for shard in self._all_shards():
+            if shard.sid == FLEET_SHARD:
+                shard.inner.update_rdzv_params(
+                    min_nodes, max_nodes, wait_new_node_s, node_unit)
+            else:
+                shard.inner.update_rdzv_params(
+                    1, max_nodes, wait_new_node_s, 1)
+
+    @property
+    def mutation_count(self) -> int:
+        total = sum(s.inner.mutation_count for s in self._all_shards())
+        with self._lock:
+            return total + self._mutations
+
+    @property
+    def alive_nodes(self) -> set:
+        alive: set = set()
+        for shard in self._all_shards():
+            alive |= shard.inner.alive_nodes
+        return alive
+
+    def add_alive_node(self, node_rank: int) -> None:
+        self._shard_for(node_rank).inner.add_alive_node(node_rank)
+
+    def remove_alive_node(self, node_rank: int,
+                          graceful: bool = False) -> None:
+        self._shard_for(node_rank).inner.remove_alive_node(
+            node_rank, graceful=graceful)
+        with self._lock:
+            # the host's staged state goes with the host; the epoch ride
+            # on the shard's own bump (inner.remove_alive_node)
+            if self._peer_stores.pop(node_rank, None) is not None:
+                self._mutations += 1
+
+    def touch(self, node_rank: int) -> None:
+        if node_rank < 0:
+            return
+        self._shard_for(node_rank).inner.touch(node_rank)
+
+    def reap_dead_nodes(self, timeout_s: float) -> None:
+        for shard in self._all_shards():
+            before = shard.inner.alive_nodes
+            shard.inner.reap_dead_nodes(timeout_s)
+            reaped = before - shard.inner.alive_nodes
+            if reaped:
+                with self._lock:
+                    for rank in reaped:
+                        if self._peer_stores.pop(rank, None) is not None:
+                            self._mutations += 1
+
+    # -- slice registry ----------------------------------------------------
+    def record_slice(self, node_rank: int, slice_id: int) -> None:
+        if slice_id < 0:
+            return
+        with self._lock:
+            if self._slices.get(node_rank) != slice_id:
+                self._slices[node_rank] = slice_id
+                self._mutations += 1
+            self._ensure_shard_locked(slice_id)
+
+    def slice_of(self, node_rank: int) -> int:
+        with self._lock:
+            return self._slices.get(node_rank, -1)
+
+    @property
+    def slice_map(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._slices)
+
+    def slice_members(self, slice_id: int) -> List[int]:
+        with self._lock:
+            return sorted(r for r, s in self._slices.items()
+                          if s == slice_id)
+
+    def slice_status(self) -> Dict:
+        with self._lock:
+            slices_map = dict(self._slices)
+            shards = {sid: shard for sid, shard in self._shards.items()
+                      if sid != FLEET_SHARD}
+        epoch = self.world_epoch
+        sids = sorted(set(slices_map.values()))
+        slices: Dict[str, Dict] = {}
+        for sid in sids:
+            members = sorted(r for r, s in slices_map.items()
+                             if s == sid)
+            shard = shards.get(sid)
+            world = shard.inner.latest_world if shard else {}
+            draining = shard.inner.draining if shard else {}
+            slices[str(sid)] = {
+                "formed": bool(world),
+                "ranks": sorted(world) if world else members,
+                "generation": shard.inner.rdzv_round if shard else 0,
+                "draining": any(r in draining for r in members),
+            }
+        return {"total": len(sids), "slices": slices, "epoch": epoch}
+
+    def world_for(self, node_rank: int) -> Dict[int, int]:
+        return self._shard_for(node_rank).inner.latest_world
+
+    def round_for(self, node_rank: int) -> int:
+        return self._shard_for(node_rank).inner.rdzv_round - 1
+
+    # -- preemption drain --------------------------------------------------
+    def mark_draining(self, node_rank: int, deadline: float
+                      ) -> Dict[int, int]:
+        return self._shard_for(node_rank).inner.mark_draining(
+            node_rank, deadline)
+
+    def complete_drain(self, node_rank: int) -> bool:
+        result = self._shard_for(node_rank).inner.complete_drain(
+            node_rank)
+        with self._lock:
+            if self._peer_stores.pop(node_rank, None) is not None:
+                self._mutations += 1
+        return result
+
+    @property
+    def draining(self) -> Dict[int, float]:
+        merged: Dict[int, float] = {}
+        for shard in self._all_shards():
+            merged.update(shard.inner.draining)
+        return merged
+
+    # -- peer-to-peer restore ----------------------------------------------
+    @property
+    def world_epoch(self) -> int:
+        total = sum(s.inner.world_epoch for s in self._all_shards())
+        with self._lock:
+            return self._epoch_base + total
+
+    def register_peer_store(self, node_rank: int, addr: str, step: int,
+                            keys, total_bytes: int = 0,
+                            slice_id: int = -1) -> None:
+        self.record_slice(node_rank, slice_id)
+        with self._lock:
+            if step < 0 or not keys:
+                if self._peer_stores.pop(node_rank, None) is not None:
+                    self._mutations += 1
+                return
+            self._peer_stores[node_rank] = {
+                "addr": addr, "step": int(step), "keys": list(keys),
+                "bytes": int(total_bytes), "ts": time.time(),
+            }
+            self._mutations += 1
+
+    @property
+    def peer_stores(self) -> Dict[int, Dict]:
+        with self._lock:
+            return {rank: dict(s)
+                    for rank, s in self._peer_stores.items()}
+
+    def compute_restore_plan(self, node_rank: int,
+                             stripe: bool = False) -> Dict:
+        # gather the shard-owned facts first — the router must never
+        # hold its own lock while taking a shard's
+        alive = self.alive_nodes
+        draining = self.draining
+        epoch = self.world_epoch
+        with self._lock:
+            stores = {
+                rank: dict(store)
+                for rank, store in self._peer_stores.items()
+                if rank in alive and rank not in draining
+            }
+            slices = dict(self._slices)
+        plan = plan_restore_entries(stores, node_rank, slices,
+                                    stripe=stripe)
+        plan["epoch"] = epoch
+        if stripe:
+            plan["mode"] = "stripe"
+        return plan
+
+    # -- online parallelism re-planning ------------------------------------
+    def set_model_profile(self, param_count: int = 0,
+                          param_bytes: int = 0,
+                          flops_per_token: float = 0.0,
+                          peak_flops_per_chip: float = 0.0,
+                          seq_len: int = 0,
+                          global_batch: int = 0,
+                          tensor_divisor: int = 0,
+                          fsdp_divisor: int = 0) -> None:
+        updates = {"param_count": param_count,
+                   "param_bytes": param_bytes,
+                   "flops_per_token": flops_per_token,
+                   "peak_flops_per_chip": peak_flops_per_chip,
+                   "seq_len": seq_len, "global_batch": global_batch,
+                   "tensor_divisor": tensor_divisor,
+                   "fsdp_divisor": fsdp_divisor}
+        with self._lock:
+            for key, value in updates.items():
+                if value and value > 0:
+                    if self._model_profile.get(key) != value:
+                        self._model_profile[key] = value
+                        self._mutations += 1
+
+    def set_chip_hbm(self, hbm_bytes: int) -> None:
+        with self._lock:
+            if hbm_bytes > 0 and self._chip_hbm_bytes != int(hbm_bytes):
+                self._chip_hbm_bytes = int(hbm_bytes)
+                self._mutations += 1
+
+    def _gather_plan_world(self) -> Dict[int, int]:
+        """The world the next plan must cover (sharded analogue of the
+        manager's ``_plan_world_locked``): per-shard cut worlds +
+        waiting lists, the remembered chips of survivors mid-re-join,
+        minus the dead and the draining. Shard locks are taken one at a
+        time, never under the router lock."""
+        worlds: Dict[int, int] = {}
+        waiting: Dict[int, int] = {}
+        alive: set = set()
+        draining: set = set()
+        for shard in self._all_shards():
+            state = shard.inner.export_protocol_view()
+            worlds.update(state["world"])
+            waiting.update(state["waiting"])
+            alive |= state["alive"]
+            draining |= set(state["draining"])
+        with self._lock:
+            chips: Dict[int, int] = dict(self._known_chips)
+        chips.update(worlds)
+        chips.update(waiting)
+        return {rank: int(n) for rank, n in chips.items()
+                if rank in alive and rank not in draining}
+
+    def compute_shard_plan(self, node_rank: int) -> Tuple[Dict, bool]:
+        from dlrover_tpu.parallel import planner
+
+        world = self._gather_plan_world()
+        rank_shard = self._shard_for(node_rank)
+        # the rank's scope stamps the plan: its shard's round doubles as
+        # the generation token (each cut bumps both, exactly like the
+        # single-lock manager's slice generation)
+        generation = rank_shard.inner.rdzv_round
+        round_ = rank_shard.inner.rdzv_round
+        has_cut = any(s.inner.rdzv_round > 0 for s in self._all_shards())
+        epoch = self.world_epoch
+        with self._lock:
+            slices = (len({self._slices.get(r, -1) for r in world})
+                      if self._slices and world else 1)
+            profile = planner.ModelProfile(
+                param_count=int(self._model_profile.get(
+                    "param_count", 0)),
+                param_bytes=int(self._model_profile.get(
+                    "param_bytes", 0)),
+                flops_per_token=float(self._model_profile.get(
+                    "flops_per_token", 0.0)),
+                peak_flops_per_chip=float(self._model_profile.get(
+                    "peak_flops_per_chip", 0.0)),
+                seq_len=int(self._model_profile.get("seq_len", 0)),
+                global_batch=int(self._model_profile.get(
+                    "global_batch", 0)),
+                hbm_bytes_per_chip=self._chip_hbm_bytes,
+                tensor_divisor=int(self._model_profile.get(
+                    "tensor_divisor", 0)),
+                fsdp_divisor=int(self._model_profile.get(
+                    "fsdp_divisor", 0)),
+            )
+            inputs = (tuple(sorted(world.items())), profile,
+                      max(1, slices), generation, epoch, round_)
+            if (self._last_plan is not None
+                    and inputs == self._last_plan_inputs):
+                return dict(self._last_plan), False
+            plan = planner.plan_parallelism(
+                world, profile, slices=max(1, slices),
+                prev_plan=self._last_plan, generation=generation,
+                epoch=epoch, round_=round_)
+            self._last_plan_inputs = inputs
+            equivalent = planner.plans_equivalent(self._last_plan, plan)
+            changed = (self._last_plan is not None and has_cut
+                       and not equivalent)
+            prev = None
+            if not equivalent:
+                prev = self._last_plan
+                self._last_plan = plan
+                self._mutations += 1
+        if changed and prev is not None:
+            obs.get_flight_recorder().record_event(
+                "replan_stamped", rdzv=self.name,
+                world_size=plan.get("world_size"),
+                devices=plan.get("total_devices"),
+                mesh=plan.get("mesh"), prev_mesh=prev.get("mesh"),
+                global_batch=plan.get("global_batch"),
+                batch_adjusted=plan.get("batch_adjusted"),
+                resharded=plan.get("resharded"),
+                generation=plan.get("generation"),
+                epoch=plan.get("epoch"))
+        return plan, changed
+
+    @property
+    def last_shard_plan(self) -> Optional[Dict]:
+        with self._lock:
+            return dict(self._last_plan) if self._last_plan else None
+
+    # -- agent-facing protocol ---------------------------------------------
+    def join_rendezvous(self, node_rank: int, local_world_size: int,
+                        node_ip: str = "", slice_id: int = -1) -> int:
+        with self._lock:
+            if (slice_id >= 0
+                    and self._slices.get(node_rank) != slice_id):
+                self._slices[node_rank] = slice_id
+                self._mutations += 1
+            sid = self._slices.get(node_rank, FLEET_SHARD)
+            shard = self._ensure_shard_locked(sid)
+            self._known_chips[node_rank] = local_world_size
+        shard.enter()
+        return shard.inner.join_rendezvous(node_rank, local_world_size,
+                                           node_ip)
+
+    def leave_waiting(self, node_rank: int) -> None:
+        self._shard_for(node_rank).inner.leave_waiting(node_rank)
+
+    def get_comm_world(self, node_rank: int
+                       ) -> Tuple[int, int, Dict[int, int]]:
+        shard = self._shard_for(node_rank)
+        shard.enter()
+        rdzv_round, group, world = shard.inner.get_comm_world(node_rank)
+        if shard.sid != FLEET_SHARD:
+            group = shard.sid
+        return rdzv_round, group, world
+
+    def num_nodes_waiting(self, node_rank: int = -1) -> int:
+        shard = self._shard_for(node_rank)
+        shard.enter()
+        return shard.inner.num_nodes_waiting(node_rank)
+
+    @property
+    def latest_world(self) -> Dict[int, int]:
+        merged: Dict[int, int] = {}
+        for shard in self._all_shards():
+            merged.update(shard.inner.latest_world)
+        return merged
+
+    @property
+    def rdzv_round(self) -> int:
+        with self._lock:
+            fleet = self._shards[FLEET_SHARD]
+        return fleet.inner.rdzv_round
+
+    # -- crash-consistent state (master/state_backend.py) ------------------
+    def export_state(self) -> dict:
+        """Per-shard partitions cut independently (each under its own
+        lock) — a shard's partition is internally consistent; cross-
+        shard skew within one export is bounded by the export itself
+        and resolved by the next mutation's snapshot."""
+        all_shards = self._all_shards()
+        shards_state = {str(shard.sid): shard.inner.export_state()
+                        for shard in all_shards}
+        restarts = {str(shard.sid): shard.restarts
+                    for shard in all_shards if shard.restarts}
+        epoch = self.world_epoch
+        with self._lock:
+            return {
+                "sharded": 1,
+                "shards": shards_state,
+                "slices": {str(r): s for r, s in self._slices.items()},
+                "world_epoch": epoch,
+                "peer_stores": {
+                    str(r): {"addr": s["addr"], "step": s["step"],
+                             "keys": list(s["keys"]),
+                             "bytes": s.get("bytes", 0)}
+                    for r, s in self._peer_stores.items()
+                },
+                "known_chips": {str(r): n for r, n
+                                in self._known_chips.items()},
+                "model_profile": dict(self._model_profile),
+                "chip_hbm_bytes": self._chip_hbm_bytes,
+                "last_plan": (dict(self._last_plan)
+                              if self._last_plan else None),
+                "shard_restarts": restarts,
+            }
+
+    def restore_state(self, state: dict) -> None:
+        if "shards" in state:
+            self._restore_sharded(state)
+        else:
+            self._restore_legacy(state)
+
+    def _restore_sharded(self, state: dict) -> None:
+        now_epoch_total = 0
+        shards: Dict[int, RendezvousShard] = {}
+        for sid_raw, shard_state in (state.get("shards") or {}).items():
+            sid = int(sid_raw)
+            params = (self._params if sid == FLEET_SHARD
+                      else self._slice_params())
+            shard = RendezvousShard(sid, params)
+            shard.inner.restore_state(shard_state)
+            shard.restarts = int(
+                (state.get("shard_restarts") or {}).get(sid_raw, 0))
+            shards[sid] = shard
+            now_epoch_total += shard.inner.world_epoch
+        if FLEET_SHARD not in shards:
+            shards[FLEET_SHARD] = RendezvousShard(FLEET_SHARD,
+                                                  self._params)
+        with self._lock:
+            self._shards = shards
+            self._slices = {int(r): int(s) for r, s in
+                            (state.get("slices") or {}).items()}
+            self._epoch_base = max(
+                0, int(state.get("world_epoch", 0)) - now_epoch_total)
+            self._restore_coordination_locked(state)
+
+    def _restore_legacy(self, state: dict) -> None:
+        """A snapshot written by the single-lock manager: split it into
+        per-shard partitions (slice worlds/rounds → slice shards, the
+        fleet fields → the fleet shard) so a sharded master — or the
+        promoted standby — can take over an old lineage in place."""
+        slices = {int(r): int(s) for r, s in
+                  (state.get("slices") or {}).items()}
+        slice_worlds = {int(sid): {int(r): int(n)
+                                   for r, n in world.items()}
+                        for sid, world in
+                        (state.get("slice_worlds") or {}).items()}
+        slice_rounds = {int(sid): int(n) for sid, n in
+                        (state.get("slice_rounds") or {}).items()}
+        alive = {int(r) for r in state.get("alive", ())}
+        waiting = {int(r): int(n)
+                   for r, n in (state.get("waiting") or {}).items()}
+        pending = {int(r) for r in state.get("pending_rejoin", ())}
+        node_ips = {int(r): ip
+                    for r, ip in (state.get("node_ips") or {}).items()}
+        draining = {int(r): float(d)
+                    for r, d in (state.get("draining") or {}).items()}
+
+        def members(sid: int) -> set:
+            return {r for r, s in slices.items() if s == sid}
+
+        shards: Dict[int, RendezvousShard] = {}
+        for sid in sorted(set(slices.values())):
+            group = members(sid)
+            shard = RendezvousShard(sid, self._slice_params())
+            shard.inner.restore_state({
+                "round": slice_rounds.get(sid, 0),
+                "latest_world": {str(r): n for r, n in
+                                 slice_worlds.get(sid, {}).items()},
+                "waiting": {str(r): n for r, n in waiting.items()
+                            if r in group},
+                "alive": sorted(alive & group),
+                "pending_rejoin": sorted(pending & group),
+                "node_ips": {str(r): ip for r, ip in node_ips.items()
+                             if r in group},
+                "draining": {str(r): d for r, d in draining.items()
+                             if r in group},
+            })
+            shards[sid] = shard
+        sliced_ranks = set(slices)
+        fleet = RendezvousShard(FLEET_SHARD, self._params)
+        fleet.inner.restore_state({
+            "round": state.get("round", 0),
+            "latest_world": state.get("latest_world", {}),
+            "waiting": {r: n for r, n in
+                        (state.get("waiting") or {}).items()
+                        if int(r) not in sliced_ranks},
+            "alive": [r for r in state.get("alive", ())
+                      if int(r) not in sliced_ranks],
+            "pending_rejoin": [r for r in state.get("pending_rejoin",
+                                                    ())
+                               if int(r) not in sliced_ranks],
+            "node_ips": {r: ip for r, ip in
+                         (state.get("node_ips") or {}).items()
+                         if int(r) not in sliced_ranks},
+            "draining": {r: d for r, d in
+                         (state.get("draining") or {}).items()
+                         if int(r) not in sliced_ranks},
+        })
+        shards[FLEET_SHARD] = fleet
+        epoch_total = sum(s.inner.world_epoch for s in shards.values())
+        with self._lock:
+            self._shards = shards
+            self._slices = slices
+            self._epoch_base = max(
+                0, int(state.get("world_epoch", 0)) - epoch_total)
+            self._restore_coordination_locked(state)
+
+    def _restore_coordination_locked(self, state: dict) -> None:
+        """(lock held) The fleet-wide coordination fields shared by both
+        snapshot formats."""
+        now = time.time()
+        self._peer_stores = {
+            int(r): {"addr": s.get("addr", ""),
+                     "step": int(s.get("step", -1)),
+                     "keys": list(s.get("keys", ())),
+                     "bytes": int(s.get("bytes", 0)),
+                     "ts": now}
+            for r, s in (state.get("peer_stores") or {}).items()
+        }
+        self._known_chips = {
+            int(r): int(n) for r, n in
+            (state.get("known_chips") or {}).items()}
+        self._model_profile = {
+            str(k): float(v) for k, v in
+            (state.get("model_profile") or {}).items()}
+        self._chip_hbm_bytes = int(state.get("chip_hbm_bytes", 0))
+        last_plan = state.get("last_plan")
+        self._last_plan = (dict(last_plan)
+                           if isinstance(last_plan, dict) else None)
+        self._last_plan_inputs = None

@@ -32,13 +32,15 @@ from dlrover_tpu.master.sync_service import ElasticPsService, SyncService
 
 # report() payloads that mutate snapshotted control-plane state (the
 # early-return branches — join/reconnect/kv-add — sink inline). The
-# per-step/heartbeat/telemetry hot paths are intentionally absent.
+# per-step/heartbeat/telemetry hot paths are intentionally absent, and
+# KeyValuePair sinks inline ONLY for cold keys: hot-prefix (dcn/,
+# coord/) sets are the gradient path — they ride the mutation log
+# instead of triggering a full snapshot per training step.
 _MUTATING_REPORTS = (
     msg.DatasetShardParams,
     msg.TaskResult,
     msg.LeaveRendezvousRequest,
     msg.NetworkStatusReport,
-    msg.KeyValuePair,
     msg.NodeFailureReport,
     msg.NodeAddressReport,   # writes node-addr/<rank> into the kv store
     msg.ShardCheckpoint,
@@ -91,6 +93,17 @@ class MasterServicer:
         # step-driven chaos for the master itself (kill:master:0@step):
         # wired by JobMaster, fed from worker GlobalStepReports
         self.master_chaos = None
+        # the coordination tier's address ("" = not split out): rides
+        # join/reconnect results so clients route hot KV traffic off the
+        # control tier (master/coord_service.py)
+        self.coord_addr = ""
+        # telemetry rides a bounded drop-oldest queue: a span storm
+        # degrades observability, never liveness
+        from dlrover_tpu.master.coord_service import TelemetryIngestQueue
+
+        self.telemetry_queue = TelemetryIngestQueue(
+            self._process_telemetry,
+            maxlen=Context.singleton().telemetry_queue_size)
 
     # ------------------------------------------------------------------
     # raw byte endpoints (wired into comm.build_server)
@@ -347,7 +360,8 @@ class MasterServicer:
             return msg.JoinRendezvousResult(
                 round=rdzv_round, generation=self.generation,
                 restore_plan_json=plan_json,
-                shard_plan_json=shard_plan_json)
+                shard_plan_json=shard_plan_json,
+                coord_addr=self.coord_addr)
         elif isinstance(request, msg.ReconnectRequest):
             return self._handle_reconnect(request)
         elif isinstance(request, msg.DrainReport):
@@ -361,9 +375,14 @@ class MasterServicer:
                                       request.elapsed_time)
         elif isinstance(request, msg.KeyValuePair):
             self.kv_store.set(request.key, request.value)
+            if not self.kv_store.is_hot(request.key):
+                # cold keys keep write-through durability; hot ones
+                # (the gradient path) ride the mutation log instead
+                self._sink_state()
         elif isinstance(request, msg.KVAddRequest):
             value = self.kv_store.add(request.key, request.amount)
-            self._sink_state()
+            if not self.kv_store.is_hot(request.key):
+                self._sink_state()
             return msg.KVIntResult(value=value)
         elif isinstance(request, msg.GlobalStepReport):
             # keyed by RANK when the sender provides one: diagnosis
@@ -508,7 +527,9 @@ class MasterServicer:
                                            0),
                     fsdp_divisor=getattr(request, "fsdp_divisor", 0))
         elif isinstance(request, msg.TelemetryReport):
-            self._ingest_telemetry(request)
+            # bounded queue + one drainer thread: the RPC returns after
+            # one append, however large the span replay backlog is
+            self.telemetry_queue.push(request)
         else:
             logger.warning("report: unknown request %s",
                            type(request).__name__)
@@ -558,7 +579,8 @@ class MasterServicer:
         self._sink_state()
         return msg.ReconnectResult(generation=self.generation,
                                    world_intact=intact,
-                                   round=latest_round)
+                                   round=latest_round,
+                                   coord_addr=self.coord_addr)
 
     def _handle_drain(self, request: msg.DrainReport) -> msg.DrainResult:
         """The advance-notice drain protocol. phase="notice": mark the
@@ -710,9 +732,10 @@ class MasterServicer:
             logger.exception("control-plane state snapshot failed")
 
     # ------------------------------------------------------------------
-    def _ingest_telemetry(self, report: msg.TelemetryReport) -> None:
+    def _process_telemetry(self, report: msg.TelemetryReport) -> None:
         """Replay a node's metric samples on the master registry and feed
-        its spans into the master flight recorder + span histogram."""
+        its spans into the master flight recorder + span histogram (runs
+        on the ingest queue's drainer thread)."""
         import json
 
         registry = obs.get_registry()

@@ -1,0 +1,233 @@
+"""Hot-standby master: tail the snapshot stream, health-check the
+primary, promote without a reconnect storm.
+
+PR 3 made a master RESTART survivable (crash-consistent snapshots +
+agent reconnection), but recovery still waited for someone to start a
+new master process and for that process to read state cold. The standby
+closes the gap:
+
+- **Warm state**: the standby tails the primary's snapshot stream (the
+  shared ``--state-dir``) and keeps the newest valid snapshot parsed in
+  memory; the hot keys snapshots deliberately exclude from their trigger
+  set ride the mutation log (state_backend.MutationLog), which promotion
+  replays on top.
+- **Health checks**: the primary's advertised address is read from the
+  bootstrap file it publishes; a cheap ``JobStatusRequest`` probes it on
+  ``Context.standby_health_interval_s``. ``standby_promote_failures``
+  CONSECUTIVE failed probes — not one blip — trigger promotion.
+- **Promotion without a storm**: the standby constructs a full
+  ``JobMaster`` from its warm state (generation = snapshot generation +
+  1) and atomically rewrites the bootstrap file. Agents already in
+  master-lost mode re-resolve from that file and re-register through the
+  EXISTING reconnect handshake; the restored rendezvous state answers
+  ``world_intact=True``, so workers never stop and nobody re-joins
+  rendezvous — PR 3's master-lost mode becomes a bounded blip, and the
+  PR 8 slice-absent budget stops ticking the moment slice status serves
+  again.
+- **Fencing**: the bootstrap file carries a generation token and
+  ``JobMaster._publish_bootstrap_addr`` refuses to overwrite a higher
+  one — a revived old primary cannot steal the fleet back
+  (double-primary split brain; see docs/fault_tolerance.md).
+
+CLI: ``python -m dlrover_tpu.master.job_master --standby --state-dir ...
+--bootstrap-file ...`` (run_master_main), or embed via
+``StandbyMaster(...).start()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+from dlrover_tpu import obs
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.state_backend import MasterStateBackend
+
+
+class StandbyMaster:
+    """One hot standby for one job's master. Watches, warms, promotes."""
+
+    def __init__(self, state_dir: str,
+                 bootstrap_file: Optional[str] = None,
+                 port: int = 0, host: str = "0.0.0.0",
+                 min_nodes: int = 1, max_nodes: int = 1,
+                 node_unit: int = 1,
+                 health_interval_s: Optional[float] = None,
+                 promote_failures: Optional[int] = None):
+        if not state_dir:
+            raise ValueError("a standby needs the primary's --state-dir "
+                             "(the snapshot stream it tails)")
+        ctx = Context.singleton()
+        if bootstrap_file:
+            ctx.update(master_bootstrap_file=bootstrap_file)
+        if not ctx.master_bootstrap_file:
+            raise ValueError(
+                "a standby needs the bootstrap file the primary "
+                "publishes (--bootstrap-file): it is both the health-"
+                "check target and the promotion handoff")
+        self._state_dir = state_dir
+        self._port = port
+        self._host = host
+        self._min_nodes = min_nodes
+        self._max_nodes = max_nodes
+        self._node_unit = node_unit
+        self._health_interval_s = (
+            health_interval_s if health_interval_s is not None
+            else ctx.standby_health_interval_s)
+        self._promote_failures = max(1, (
+            promote_failures if promote_failures is not None
+            else ctx.standby_promote_failures))
+        self._backend = MasterStateBackend(state_dir)
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # warm state: (state dict, snapshot version) — what promotion
+        # hands to JobMaster so it skips the cold disk read
+        self.warm_state: Optional[Tuple[dict, int]] = None
+        self.warm_version = -1
+        self.consecutive_failures = 0
+        self.promoted_master = None
+        self._probe_client = None
+        self._probe_addr = ""
+
+    # -- snapshot tailing -------------------------------------------------
+    def refresh_warm_state(self) -> bool:
+        """Load the newest snapshot if the stream advanced past what we
+        hold; returns whether anything new was adopted."""
+        versions = self._backend.versions()
+        if not versions or versions[-1] <= self.warm_version:
+            return False
+        loaded = self._backend.load_latest()
+        if loaded is None:
+            return False
+        state, version = loaded
+        if version <= self.warm_version:
+            return False
+        self.warm_state = (state, version)
+        self.warm_version = version
+        obs.get_registry().gauge(
+            "dlrover_tpu_standby_warm_snapshot_version",
+            "Newest snapshot version the hot standby holds parsed in "
+            "memory").set(version)
+        return True
+
+    # -- health checking --------------------------------------------------
+    def _primary_addr(self) -> str:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        return MasterClient.resolve_bootstrap().get("addr", "")
+
+    def check_primary(self) -> bool:
+        """One probe: resolve the primary from the bootstrap file and
+        round-trip a JobStatusRequest with a short deadline. No
+        published primary yet = healthy (nothing to take over)."""
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        addr = self._primary_addr()
+        if not addr:
+            return True
+        if addr != self._probe_addr or self._probe_client is None:
+            if self._probe_client is not None:
+                try:
+                    self._probe_client.close()
+                except Exception:  # noqa: BLE001 — dead channel
+                    pass
+            self._probe_client = MasterClient(
+                addr, node_id=-1, node_type="standby",
+                timeout_s=max(1.0, self._health_interval_s))
+            self._probe_addr = addr
+        try:
+            self._probe_client.get_job_status()
+            return True
+        except Exception:  # noqa: BLE001 — any failure is a failed probe
+            return False
+
+    # -- the watch loop ---------------------------------------------------
+    def run(self) -> int:
+        """Watch until promotion (then serve as the master: returns its
+        exit code) or stop() (returns 0)."""
+        logger.info(
+            "hot standby watching %s (probe every %.1fs, promote after "
+            "%d consecutive failures)", self._state_dir,
+            self._health_interval_s, self._promote_failures)
+        obs.get_flight_recorder().record_event(
+            "standby_started", state_dir=self._state_dir,
+            health_interval_s=self._health_interval_s,
+            promote_failures=self._promote_failures)
+        while not self._stopped.is_set():
+            self.refresh_warm_state()
+            if self.check_primary():
+                self.consecutive_failures = 0
+            else:
+                self.consecutive_failures += 1
+                logger.warning(
+                    "primary health probe failed (%d/%d consecutive)",
+                    self.consecutive_failures, self._promote_failures)
+                if self.consecutive_failures >= self._promote_failures:
+                    master = self.promote()
+                    if master is not None:
+                        return master.run()
+            self._stopped.wait(self._health_interval_s)
+        return 0
+
+    def start(self) -> threading.Thread:
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="standby-master")
+        self._thread.start()
+        return self._thread
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self.promoted_master is not None:
+            self.promoted_master.stop(grace_s=0.1)
+        if self._probe_client is not None:
+            try:
+                self._probe_client.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- promotion --------------------------------------------------------
+    def promote(self):
+        """Become the primary: a full JobMaster from the warm state
+        (generation = snapshot's + 1, mutation log replayed), serving
+        immediately, bootstrap file atomically rewritten. Agents'
+        reconnect handshakes find their worlds intact in the restored
+        rendezvous state — zero worker restarts, zero re-register
+        storm."""
+        from dlrover_tpu.master.job_master import JobMaster
+
+        started = time.monotonic()
+        # one last look at the stream: the primary may have snapshotted
+        # between our last tail and its death
+        self.refresh_warm_state()
+        logger.critical(
+            "PROMOTING: primary failed %d consecutive health probes; "
+            "standby takes over from snapshot v%d",
+            self.consecutive_failures, self.warm_version)
+        master = JobMaster(
+            port=self._port, min_nodes=self._min_nodes,
+            max_nodes=self._max_nodes, node_unit=self._node_unit,
+            host=self._host, state_dir=self._state_dir,
+            preloaded_state=self.warm_state)
+        master.prepare()   # serves + publishes the bootstrap handoff
+        took_s = time.monotonic() - started
+        self.promoted_master = master
+        obs.get_flight_recorder().record_event(
+            "master_promoted", addr=master.addr,
+            coord_addr=master.coord_addr,
+            generation=master.generation,
+            snapshot_version=self.warm_version,
+            failed_probes=self.consecutive_failures,
+            promotion_s=round(took_s, 4))
+        obs.get_registry().counter(
+            "dlrover_tpu_master_promotions_total",
+            "Hot-standby masters promoted to primary").inc()
+        obs.record_span("master_promotion", took_s,
+                        attrs={"generation": master.generation,
+                               "snapshot_version": self.warm_version})
+        logger.critical(
+            "PROMOTED in %.3fs: serving at %s (coord %s) as generation "
+            "%d", took_s, master.addr, master.coord_addr or "-",
+            master.generation)
+        return master

@@ -23,18 +23,20 @@ docs/fault_tolerance.md for the snapshot format and recovery sequence.
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import json
 import os
 import re
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.log import default_logger as logger
 
 _SNAPSHOT_RE = re.compile(r"^master-state-(\d{10})\.json$")
 _FORMAT_VERSION = 1
+MUTATION_LOG_NAME = "kv-mutlog.jsonl"
 
 
 def _canonical(state: Dict[str, Any]) -> str:
@@ -48,6 +50,180 @@ def _checksum(payload: str) -> str:
 
 class SnapshotCorruptionError(RuntimeError):
     """A snapshot file failed its checksum / structure validation."""
+
+
+class MutationLog:
+    """Append-only log of the durable-worthy HOT mutations (the
+    ``coord/`` barrier keys) between snapshots.
+
+    Snapshots deliberately exclude the gradient-path keys from their
+    TRIGGER set (a full export+fsync per training step would put storage
+    in the step loop), so the barrier mutations land here instead: one
+    JSON line per mutation, buffered writes, NO fsync. ``append`` is an
+    in-memory ENQUEUE — a background drainer owns the disk, so the kv
+    store's condition lock never waits on the (typically shared/NFS)
+    state volume. The log is ROTATED (truncated) every time a snapshot
+    is written, because the snapshot's state export includes the hot
+    keys' values at that instant: replaying the (strictly newer) log
+    over the latest snapshot is therefore always last-wins correct. A
+    restarted master — or a promoted hot standby — replays it via
+    ``KVStoreService.replay_mutations``.
+
+    ``gate``: an optional callable the DRAINER consults before each
+    write; truthy = this master has been fenced (a higher-generation
+    master owns the lineage) and the entries are discarded instead of
+    written. Checking on the drainer thread means fencing bites even
+    when ONLY hot traffic is flowing (nothing else would run the
+    fence check), and the check's own file read never runs under the
+    kv lock.
+    """
+
+    def __init__(self, directory: str):
+        self._path = os.path.join(directory, MUTATION_LOG_NAME)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._file = None
+        self._seq = 0
+        self._queue: List[str] = []
+        self._in_flight = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self.gate = None
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        with self._lock:
+            return self._path
+
+    def append(self, key: str, value: bytes) -> None:
+        """Enqueue the RESULTING value of a mutation (b"" = the key was
+        deleted); the drainer writes it. Cheap by design: callers hold
+        the kv store's condition lock."""
+        line = json.dumps({
+            "seq": self._seq,
+            "k": key,
+            "v": base64.b64encode(value).decode("ascii"),
+        })
+        with self._cond:
+            if self._stopped:
+                return
+            self._seq += 1
+            self._queue.append(line)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._drain, daemon=True,
+                    name="kv-mutlog-writer")
+                self._thread.start()
+            self._cond.notify_all()
+
+    def _drain(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopped:
+                    self._cond.wait()
+                if self._stopped and not self._queue:
+                    return
+                batch = self._queue
+                self._queue = []
+                self._in_flight = len(batch)
+            gate = self.gate
+            try:
+                if gate is not None and gate():
+                    # fenced: a higher-generation master owns this
+                    # lineage — drop instead of corrupting its log
+                    continue
+                with self._lock:
+                    if self._file is None:
+                        self._file = open(self._path, "a")
+                    self._file.write("\n".join(batch) + "\n")
+                    self._file.flush()
+            except OSError as e:
+                logger.warning("mutation log append failed: %s", e)
+            except Exception:  # noqa: BLE001 — a broken gate must not
+                # kill the writer
+                logger.exception("mutation log gate failed")
+            finally:
+                with self._cond:
+                    self._in_flight = 0
+                    self._cond.notify_all()
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Block until everything appended so far is on disk (or was
+        gate-discarded). Returns False on timeout."""
+        import time as time_mod
+
+        deadline = time_mod.time() + timeout_s
+        with self._cond:
+            while self._queue or self._in_flight:
+                remaining = deadline - time_mod.time()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+    def rotate(self) -> None:
+        """Truncate after a snapshot write: every logged mutation is now
+        part of (or older than) the durable snapshot."""
+        with self._cond:
+            self._queue = []
+            try:
+                if self._file is not None:
+                    self._file.close()
+                    self._file = None
+                tmp = f"{self._path}.{os.getpid()}.tmp"
+                with open(tmp, "w"):
+                    pass
+                os.replace(tmp, self._path)
+            except OSError as e:
+                logger.warning("mutation log rotate failed: %s", e)
+
+    def close(self) -> None:
+        self.flush(timeout_s=2.0)
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+            thread = self._thread
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+        if thread is not None:
+            thread.join(timeout=2.0)
+
+    @staticmethod
+    def read(directory: str) -> List[Tuple[str, bytes]]:
+        """(key, value) pairs in append order, SKIPPING malformed lines
+        (a torn tail on crash, or a partial write the writer survived
+        and appended past — truncating at the first bad line would
+        silently drop every committed mutation after it; skipping is
+        safe under the replay's last-wins semantics). Empty when no log
+        exists."""
+        path = os.path.join(directory, MUTATION_LOG_NAME)
+        entries: List[Tuple[str, bytes]] = []
+        skipped = 0
+        try:
+            with open(path) as f:
+                lines: Iterable[str] = f.readlines()
+        except OSError:
+            return entries
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+                entries.append((str(record["k"]),
+                                base64.b64decode(record["v"])))
+            except (ValueError, KeyError):
+                skipped += 1
+        if skipped:
+            logger.warning(
+                "mutation log %s: %d malformed line(s) skipped "
+                "(torn/partial writes)", path, skipped)
+        return entries
 
 
 class MasterStateBackend:

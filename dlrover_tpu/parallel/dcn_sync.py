@@ -55,6 +55,12 @@ import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
 
+# Legacy (pre-episode-hygiene) key names: used when the master's slice
+# status carries no world epoch. Epoch-aware masters get generation-
+# namespaced keys (``dcn/g<E>/...``) instead: every membership loss
+# moves the fleet to a fresh namespace, so a stale previous-episode
+# payload can never be re-adopted, and the kv store garbage-collects
+# the superseded namespaces (master/kv_store.py).
 GRAD_KEY_PREFIX = "dcn/grads/"
 REJOIN_KEY = "dcn/rejoin"
 STATE_KEY = "dcn/state"
@@ -210,6 +216,10 @@ class SliceGradSync:
         # (master outage) must still count local-only steps as DEGRADED
         # — syncing with nobody IS the shrunken mean the budget bounds
         self._last_known_total = 0
+        # the world epoch the master last reported (-1 = unknown /
+        # legacy master): namespaces every dcn/ key so payloads from a
+        # previous membership episode are unreachable by construction
+        self._epoch = -1
         registry = obs.get_registry()
         self._degraded_counter = registry.counter(
             "dlrover_tpu_slice_degraded_steps_total",
@@ -226,12 +236,30 @@ class SliceGradSync:
     # -- master status ------------------------------------------------------
     def _status(self) -> Dict[str, Any]:
         try:
-            return self._client.get_slice_status() or {}
+            status = self._client.get_slice_status() or {}
         except Exception:  # noqa: BLE001 — a master blip must not kill
             # the step; syncing with nobody is the safe degradation
             logger.warning("slice status unavailable; treating the "
                            "fleet as this slice only for this step")
+            # the master may have MOVED (standby promotion — workers
+            # are deliberately not respawned): re-dial from the
+            # bootstrap file so the degraded episode ends with the
+            # promotion instead of stalling out the absent budget
+            try:
+                reresolve = getattr(self._client, "reresolve_if_moved",
+                                    None)
+                if reresolve is not None:
+                    reresolve()
+            except Exception:  # noqa: BLE001 — next step retries
+                pass
             return {}
+        epoch = status.get("epoch")
+        if epoch is not None:
+            try:
+                self._epoch = int(epoch)
+            except (TypeError, ValueError):
+                pass
+        return status
 
     @staticmethod
     def _formed_slices(status: Dict[str, Any]) -> Dict[int, bool]:
@@ -244,9 +272,22 @@ class SliceGradSync:
         return out
 
     # -- keys ---------------------------------------------------------------
-    @staticmethod
-    def _grad_key(slice_id: int) -> str:
-        return f"{GRAD_KEY_PREFIX}{slice_id}"
+    def _ns(self, suffix: str) -> str:
+        """Epoch-namespaced key (legacy bare name when the master never
+        reported an epoch). All slices read the epoch from the same
+        master status, so writers and readers of one episode agree."""
+        if self._epoch < 0:
+            return f"dcn/{suffix}"
+        return f"dcn/g{self._epoch}/{suffix}"
+
+    def _grad_key(self, slice_id: int) -> str:
+        return self._ns(f"grads/{slice_id}")
+
+    def _rejoin_key(self) -> str:
+        return self._ns("rejoin")
+
+    def _state_key(self) -> str:
+        return self._ns("state")
 
     # -- rejoin handoff (survivor side) -------------------------------------
     def _service_rejoin(self, step: int,
@@ -262,7 +303,7 @@ class SliceGradSync:
         if state_leaves_fn is None or not self.is_leader:
             return
         try:
-            raw = self._client.kv_get(REJOIN_KEY)
+            raw = self._client.kv_get(self._rejoin_key())
         except Exception:  # noqa: BLE001 — next step retries
             return
         if not raw:
@@ -274,7 +315,7 @@ class SliceGradSync:
             token = str(request.get("token", ""))
         except (ValueError, TypeError):
             # garbage request: clear it so it cannot wedge the channel
-            self._try_kv_set(REJOIN_KEY, b"")
+            self._try_kv_set(self._rejoin_key(), b"")
             return
         if asking == self.slice_id:
             return          # our own pending request — not our job
@@ -284,7 +325,7 @@ class SliceGradSync:
             return
         if from_step >= step - 1:
             # the rejoiner is already current; just clear the request
-            self._try_kv_set(REJOIN_KEY, b"")
+            self._try_kv_set(self._rejoin_key(), b"")
             return
         from dlrover_tpu import obs
 
@@ -295,8 +336,8 @@ class SliceGradSync:
                                 extra={"kind": "state",
                                        "from_slice": self.slice_id,
                                        "token": token})
-        if self._try_kv_set(STATE_KEY, payload):
-            self._try_kv_set(REJOIN_KEY, b"")
+        if self._try_kv_set(self._state_key(), payload):
+            self._try_kv_set(self._rejoin_key(), b"")
             logger.warning(
                 "slice %d: published fleet state @ step %d for "
                 "re-formed slice %d (%d bytes)", self.slice_id,
@@ -340,7 +381,7 @@ class SliceGradSync:
 
         token = _os.urandom(8).hex()
         if self.is_leader:
-            self._try_kv_set(REJOIN_KEY, json.dumps(
+            self._try_kv_set(self._rejoin_key(), json.dumps(
                 {"slice": self.slice_id, "step": start_step,
                  "token": token}).encode())
         logger.warning(
@@ -366,15 +407,15 @@ class SliceGradSync:
                     and self._clock() - last_repost >= 1.0):
                 last_repost = self._clock()
                 try:
-                    if not self._client.kv_get(REJOIN_KEY):
-                        self._try_kv_set(REJOIN_KEY, json.dumps(
+                    if not self._client.kv_get(self._rejoin_key()):
+                        self._try_kv_set(self._rejoin_key(), json.dumps(
                             {"slice": self.slice_id,
                              "step": start_step,
                              "token": token}).encode())
                 except Exception:  # noqa: BLE001 — next tick retries
                     pass
             try:
-                raw = self._client.kv_get(STATE_KEY)
+                raw = self._client.kv_get(self._state_key())
             except Exception:  # noqa: BLE001
                 raw = b""
             if peek_step(raw) >= min_step:

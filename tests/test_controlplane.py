@@ -1,0 +1,995 @@
+"""Sharded hot-standby control plane: per-slice rendezvous shards
+(independence, wedge/restart isolation, state partitions), the split
+KV/coordination tier (hot-key routing, lock-free reads, generation GC,
+mutation log), the bounded telemetry ingest, and standby promotion
+(chaos-killed primary -> warm takeover with zero worker restarts,
+asserted from flight events)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu import obs
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.master.kv_store import KVStoreService, split_generation
+from dlrover_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+    RendezvousParameters,
+)
+from dlrover_tpu.master.rendezvous_shards import ShardedRendezvousManager
+from dlrover_tpu.master.state_backend import MutationLog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _params(min_nodes=1, max_nodes=8, wait_s=0.2):
+    return RendezvousParameters(min_nodes=min_nodes,
+                                max_nodes=max_nodes,
+                                wait_new_node_s=wait_s)
+
+
+def _form(mgr, layout):
+    """layout: {rank: slice_id}. Joins everyone then polls each rank
+    once so every slice's world cuts."""
+    for rank, sid in layout.items():
+        mgr.join_rendezvous(rank, 1, slice_id=sid)
+    return {rank: mgr.get_comm_world(rank) for rank in layout}
+
+
+# ---------------------------------------------------------------------------
+# sharded rendezvous router: drop-in semantics
+# ---------------------------------------------------------------------------
+
+
+class TestShardedRouter:
+    def test_slice_worlds_cut_independently_with_group_ids(self):
+        mgr = ShardedRendezvousManager(_params())
+        worlds = _form(mgr, {0: 0, 1: 0, 2: 1, 3: 1})
+        assert worlds[0] == (0, 0, {0: 1, 1: 1})
+        assert worlds[2] == (0, 1, {2: 1, 3: 1})
+        assert mgr.latest_world == {0: 1, 1: 1, 2: 1, 3: 1}
+        status = mgr.slice_status()
+        assert status["total"] == 2
+        assert status["slices"]["0"]["generation"] == 1
+        assert status["epoch"] == 0
+
+    def test_member_death_invalidates_only_its_shard(self):
+        mgr = ShardedRendezvousManager(_params())
+        _form(mgr, {0: 0, 1: 0, 2: 1, 3: 1})
+        before = obs.get_flight_recorder().snapshot()
+        mgr.remove_alive_node(0)
+        assert mgr.get_comm_world(1)[2] == {}
+        assert mgr.num_nodes_waiting(1) >= 1
+        # the survivor shard: same world, same round, no restart signal
+        assert mgr.get_comm_world(2) == (0, 1, {2: 1, 3: 1})
+        assert mgr.num_nodes_waiting(2) == 0
+        assert mgr.world_epoch == 1
+        events = [e for e in obs.get_flight_recorder().snapshot()
+                  if e not in before
+                  and e.get("name") == "slice_world_invalidated"]
+        assert events and events[-1]["attrs"]["slice"] == 0
+        # victim slice re-forms alone with a bumped generation
+        mgr.join_rendezvous(0, 1, slice_id=0)
+        mgr.join_rendezvous(1, 1, slice_id=0)
+        assert mgr.get_comm_world(0) == (1, 0, {0: 1, 1: 1})
+        status = mgr.slice_status()
+        assert status["slices"]["0"]["generation"] == 2
+        assert status["slices"]["1"]["generation"] == 1
+
+    def test_sliceless_job_routes_to_fleet_shard_with_job_params(self):
+        mgr = ShardedRendezvousManager(_params(min_nodes=2, max_nodes=2))
+        mgr.join_rendezvous(0, 4)
+        assert mgr.get_comm_world(0)[2] == {}   # min_nodes honored
+        mgr.join_rendezvous(1, 4)
+        assert mgr.get_comm_world(0) == (0, 0, {0: 4, 1: 4})
+        assert mgr.rdzv_round == 1
+
+    def test_state_roundtrip_sharded_format(self):
+        mgr = ShardedRendezvousManager(_params())
+        _form(mgr, {0: 0, 1: 0, 2: 1, 3: 1})
+        mgr.remove_alive_node(0)
+        mgr.join_rendezvous(0, 1, slice_id=0)
+        mgr.join_rendezvous(1, 1, slice_id=0)
+        mgr.get_comm_world(0)
+        mgr.register_peer_store(2, "h2:1", 5, ["a"], 10, slice_id=1)
+        state = mgr.export_state()
+        assert state["sharded"] == 1
+        fresh = ShardedRendezvousManager(_params())
+        fresh.restore_state(state)
+        assert fresh.slice_status() == mgr.slice_status()
+        assert fresh.latest_world == mgr.latest_world
+        assert fresh.world_epoch == mgr.world_epoch
+        assert fresh.peer_stores.keys() == mgr.peer_stores.keys()
+
+    def test_sharded_snapshot_downgrades_into_single_lock_manager(self):
+        """The rdzv_sharded=0 escape hatch over an existing sharded
+        lineage: the flat manager flattens the per-shard partitions
+        instead of silently restoring an empty protocol state."""
+        mgr = ShardedRendezvousManager(_params())
+        _form(mgr, {0: 0, 1: 0, 2: 1, 3: 1})
+        mgr.register_peer_store(2, "h2:1", 5, ["a"], 10, slice_id=1)
+        downgraded = ElasticTrainingRendezvousManager(_params())
+        downgraded.restore_state(mgr.export_state())
+        assert downgraded.slice_status() == mgr.slice_status()
+        assert downgraded.latest_world == mgr.latest_world
+        assert downgraded.get_comm_world(2) == (0, 1, {2: 1, 3: 1})
+        assert downgraded.alive_nodes == mgr.alive_nodes
+        assert downgraded.peer_stores.keys() == mgr.peer_stores.keys()
+
+    def test_legacy_single_lock_snapshot_upgrades_into_shards(self):
+        """A snapshot written by the single-lock manager restores into
+        the router (promotion/restart can take over an old lineage)."""
+        old = ElasticTrainingRendezvousManager(_params())
+        _form(old, {0: 0, 1: 0, 2: 1, 3: 1})
+        old.register_peer_store(2, "h2:1", 5, ["a"], 10, slice_id=1)
+        upgraded = ShardedRendezvousManager(_params())
+        upgraded.restore_state(old.export_state())
+        assert upgraded.slice_status() == old.slice_status()
+        assert upgraded.latest_world == old.latest_world
+        assert upgraded.get_comm_world(2) == (0, 1, {2: 1, 3: 1})
+        assert upgraded.peer_stores.keys() == old.peer_stores.keys()
+
+    def test_restore_plan_prefers_same_slice_donors(self):
+        mgr = ShardedRendezvousManager(_params())
+        _form(mgr, {0: 0, 1: 0, 2: 1})
+        mgr.register_peer_store(1, "h1:1", 5, ["a"], 10, slice_id=0)
+        mgr.register_peer_store(2, "h2:1", 5, ["a", "b"], 10,
+                                slice_id=1)
+        plan = mgr.compute_restore_plan(0)
+        assert plan["entries"]["a"] == {"rank": 1, "addr": "h1:1",
+                                       "tier": "same-slice"}
+        assert plan["entries"]["b"]["tier"] == "cross-slice"
+        assert plan["epoch"] == mgr.world_epoch
+
+    def test_draining_routes_to_the_ranks_shard(self):
+        mgr = ShardedRendezvousManager(_params())
+        _form(mgr, {0: 0, 1: 0, 2: 1, 3: 1})
+        planned = mgr.mark_draining(0, time.time() + 30.0)
+        assert planned == {1: 1}
+        assert set(mgr.draining) == {0}
+        # peer slice untouched
+        assert not mgr.slice_status()["slices"]["1"]["draining"]
+        mgr.complete_drain(0)
+        assert mgr.draining == {}
+
+
+# ---------------------------------------------------------------------------
+# shard independence: wedge + restart (the regression the ISSUE names)
+# ---------------------------------------------------------------------------
+
+
+class TestShardIsolation:
+    def test_wedged_shard_does_not_delay_another_slices_cut(self):
+        """Wedge slice 0's shard (chaos delay): slice 1's full
+        join -> cut cycle must be unaffected while slice 0's callers
+        stall at the router boundary."""
+        mgr = ShardedRendezvousManager(_params())
+        _form(mgr, {0: 0, 1: 0, 2: 1, 3: 1})
+        assert mgr.wedge_shard(0, 1.2)
+        wedged_done = {}
+
+        def wedged_caller():
+            t0 = time.monotonic()
+            mgr.get_comm_world(0)
+            wedged_done["elapsed"] = time.monotonic() - t0
+
+        blocked = threading.Thread(target=wedged_caller, daemon=True)
+        blocked.start()
+        # a full membership-change cycle on slice 1, timed
+        t0 = time.monotonic()
+        mgr.remove_alive_node(2)
+        mgr.join_rendezvous(2, 1, slice_id=1)
+        mgr.join_rendezvous(3, 1, slice_id=1)
+        rdzv_round, group, world = mgr.get_comm_world(2)
+        cycle_s = time.monotonic() - t0
+        assert (rdzv_round, group, world) == (1, 1, {2: 1, 3: 1})
+        assert cycle_s < 0.5, (
+            f"slice 1's cut took {cycle_s:.2f}s while slice 0 was "
+            f"wedged — shards are not independent")
+        blocked.join(timeout=5.0)
+        assert wedged_done["elapsed"] >= 1.0, (
+            "the wedge itself must actually stall slice 0's callers")
+
+    def test_single_lock_baseline_blocks_fleetwide_for_contrast(self):
+        """The property the sharding buys: the OLD manager holds ONE
+        lock, so anything stuck under it stalls every slice."""
+        mgr = ElasticTrainingRendezvousManager(_params())
+        _form(mgr, {0: 0, 1: 0, 2: 1, 3: 1})
+        release = threading.Event()
+        held = threading.Event()
+
+        def hold_lock():
+            with mgr._lock:
+                held.set()
+                release.wait(2.0)
+
+        holder = threading.Thread(target=hold_lock, daemon=True)
+        holder.start()
+        assert held.wait(2.0)
+        t0 = time.monotonic()
+        done = {}
+
+        def poll():
+            done["world"] = mgr.get_comm_world(2)
+            done["elapsed"] = time.monotonic() - t0
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        time.sleep(0.3)
+        stuck = "elapsed" not in done
+        release.set()
+        poller.join(timeout=5.0)
+        holder.join(timeout=5.0)
+        assert stuck, "single-lock manager should have stalled slice 1"
+
+    def test_shard_restart_rebuilds_from_partition_alone(self):
+        mgr = ShardedRendezvousManager(_params())
+        _form(mgr, {0: 0, 1: 0, 2: 1, 3: 1})
+        survivor_world = mgr.get_comm_world(2)
+        before = obs.get_flight_recorder().snapshot()
+        assert mgr.restart_shard(0)
+        # the restarted shard answers from its restored partition
+        assert mgr.get_comm_world(0) == (0, 0, {0: 1, 1: 1})
+        assert mgr.shard(0).restarts == 1
+        # the peer shard object was never touched
+        assert mgr.get_comm_world(2) == survivor_world
+        assert mgr.shard(1).restarts == 0
+        events = [e for e in obs.get_flight_recorder().snapshot()
+                  if e not in before
+                  and e.get("name") == "shard_restarted"]
+        assert events and events[-1]["attrs"]["slice"] == 0
+
+    def test_restart_from_state_partition_when_actor_unexportable(self):
+        mgr = ShardedRendezvousManager(_params())
+        _form(mgr, {0: 0, 1: 0, 2: 1})
+        partition = mgr.shard(0).inner.export_state()
+        # wreck the live shard, then restart from the partition
+        mgr.shard(0).inner._latest_world = {"bogus": "state"}
+        assert mgr.restart_shard(0, from_state=partition)
+        assert mgr.get_comm_world(0) == (0, 0, {0: 1, 1: 1})
+
+
+# ---------------------------------------------------------------------------
+# kv store: hot prefixes, lock-free reads, generation GC, mutation log
+# ---------------------------------------------------------------------------
+
+
+class TestKVEpisodeHygiene:
+    def test_split_generation_parses_the_namespaced_shapes(self):
+        assert split_generation("dcn/g4/state") == ("dcn//state", 4)
+        assert split_generation("dcn/g4/grads/1") == ("dcn//grads/1", 4)
+        assert split_generation("coord/elastic-training/slice0/3") == (
+            "coord/elastic-training/slice0/", 3)
+        assert split_generation("coord/elastic-training/7") == (
+            "coord/elastic-training/", 7)
+        assert split_generation("coord/network-check/2/0") == (
+            "coord/network-check//0", 2)
+        assert split_generation("node-addr/3") is None
+        assert split_generation("dcn/grads/1") is None   # legacy name
+
+    def test_superseded_generations_are_collected_with_counter(self):
+        kv = KVStoreService(keep_generations=2)
+        kv.set("dcn/g0/state", b"old")
+        kv.set("dcn/g1/state", b"mid")
+        kv.set("dcn/g2/state", b"new")
+        assert kv.get("dcn/g0/state") == b""       # collected
+        assert kv.get("dcn/g1/state") == b"mid"    # kept (N-1)
+        assert kv.get("dcn/g2/state") == b"new"
+        assert kv.collected_total == 1
+        # groups are independent: grads/0 vs grads/1 vs state
+        kv.set("dcn/g2/grads/0", b"a")
+        kv.set("dcn/g2/grads/1", b"b")
+        assert kv.collected_total == 1
+        rendered = obs.get_registry().render()
+        assert "dlrover_tpu_kv_gc_keys_total" in rendered
+
+    def test_coordinator_rounds_are_collected_per_slice_group(self):
+        kv = KVStoreService(keep_generations=2)
+        for rdzv_round in range(4):
+            kv.set(f"coord/elastic-training/slice0/{rdzv_round}",
+                   str(rdzv_round).encode())
+        assert kv.get("coord/elastic-training/slice0/0") == b""
+        assert kv.get("coord/elastic-training/slice0/1") == b""
+        assert kv.get("coord/elastic-training/slice0/3") == b"3"
+        # another slice's rounds are a different group
+        kv.set("coord/elastic-training/slice1/0", b"x")
+        assert kv.get("coord/elastic-training/slice1/0") == b"x"
+
+    def test_hot_prefix_detection(self):
+        kv = KVStoreService()
+        assert kv.is_hot("dcn/g0/grads/0")
+        assert kv.is_hot("coord/elastic-training/slice0/1")
+        assert not kv.is_hot("node-addr/3")
+        assert not kv.is_hot("coordinator")
+
+    def test_restore_rebuilds_generation_index(self):
+        kv = KVStoreService(keep_generations=2)
+        kv.set("dcn/g5/state", b"five")
+        kv.set("dcn/g6/state", b"six")
+        fresh = KVStoreService(keep_generations=2)
+        fresh.restore_state(kv.export_state())
+        fresh.set("dcn/g7/state", b"seven")
+        assert fresh.get("dcn/g5/state") == b""   # hygiene resumed
+        assert fresh.get("dcn/g6/state") == b"six"
+
+
+class TestMutationLog:
+    def test_append_read_roundtrip_and_torn_tail(self, tmp_path):
+        log = MutationLog(str(tmp_path))
+        log.append("dcn/g0/state", b"payload")
+        log.append("dcn/g0/rejoin", b"")
+        log.close()
+        with open(log.path, "a") as f:
+            f.write('{"seq": 2, "k": "torn')   # crash mid-line
+        entries = MutationLog.read(str(tmp_path))
+        assert entries == [("dcn/g0/state", b"payload"),
+                           ("dcn/g0/rejoin", b"")]
+
+    def test_rotate_truncates(self, tmp_path):
+        log = MutationLog(str(tmp_path))
+        log.append("dcn/g0/state", b"payload")
+        assert log.flush()
+        log.rotate()
+        assert MutationLog.read(str(tmp_path)) == []
+        log.append("dcn/g1/state", b"after")
+        assert log.flush()
+        assert MutationLog.read(str(tmp_path)) == [
+            ("dcn/g1/state", b"after")]
+        log.close()
+
+    def test_gate_discards_instead_of_writing(self, tmp_path):
+        """The fence hook: a gated (superseded) master's drainer drops
+        entries rather than corrupting the promoted lineage's log —
+        checked on the DRAINER thread so hot-only traffic (which never
+        snapshots) still stops."""
+        log = MutationLog(str(tmp_path))
+        log.gate = lambda: True
+        log.append("coord/elastic-training/0", b"stale")
+        assert log.flush()
+        log.close()
+        assert MutationLog.read(str(tmp_path)) == []
+
+    def test_kv_store_logs_coord_mutations_and_replays(self, tmp_path):
+        kv = KVStoreService()
+        log = MutationLog(str(tmp_path))
+        kv.attach_mutation_log(log)
+        # dcn/ payloads are deliberately NOT logged: per-step ephemeral
+        # and large — logging them would put a multi-MB disk write on
+        # the gradient path and grow the log unbounded
+        kv.set("dcn/g0/grads/0", b"x" * 4096)
+        kv.set("coordinator", b"cold")           # cold: snapshots, not log
+        kv.add("coord/elastic-training/slice0/0", 2)
+        assert log.flush()
+        entries = MutationLog.read(str(tmp_path))
+        assert entries == [("coord/elastic-training/slice0/0", b"2")]
+        fresh = KVStoreService()
+        assert fresh.replay_mutations(entries) == 1
+        assert fresh.get("coord/elastic-training/slice0/0") == b"2"
+
+
+# ---------------------------------------------------------------------------
+# the coordination tier over real RPC
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def cp_ctx(tmp_path):
+    ctx = Context.singleton()
+    ctx.update(
+        rpc_timeout_s=2.0,
+        rpc_retries=2,
+        rpc_backoff_s=0.02,
+        rpc_backoff_max_s=0.05,
+        master_state_dir="",
+        master_bootstrap_file=str(tmp_path / "master.addr"),
+    )
+    yield ctx
+    Context.reset()
+
+
+class TestCoordinationTier:
+    def test_split_tier_serves_hot_kv_and_slice_status(self, cp_ctx,
+                                                       tmp_path):
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.master.job_master import JobMaster
+
+        master = JobMaster(port=0, min_nodes=1, max_nodes=2,
+                           host="127.0.0.1",
+                           state_dir=str(tmp_path / "state"))
+        master.prepare()
+        try:
+            assert master.coord_addr and \
+                master.coord_addr != master.addr
+            client = MasterClient(master.addr, node_id=0)
+            client.join_rendezvous(local_world_size=1)
+            # the join result taught the client the coordination addr
+            assert client.coord_addr == master.coord_addr
+            # hot traffic round-trips through the coordination port
+            assert client.kv_set("dcn/g0/grads/0", b"payload")
+            assert client.kv_get("dcn/g0/grads/0") == b"payload"
+            status = client.get_slice_status()
+            assert status["total"] == 0 and "epoch" in status
+            # cold keys keep write-through snapshot durability
+            versions_before = master._state_backend.versions()[-1]
+            client.kv_set("coordinator", b"10.0.0.1:1")
+            assert master._state_backend.versions()[-1] > \
+                versions_before
+            # ... while hot sets never snapshot: coord/ barriers ride
+            # the mutation log, dcn/ payloads are deliberately
+            # ephemeral (per-step, overwritten, absence = absence)
+            versions_mid = master._state_backend.versions()[-1]
+            client.kv_set("dcn/g0/grads/1", b"hot2")
+            client.kv_set("coord/elastic-training/0", b"barrier")
+            assert master._state_backend.versions()[-1] == versions_mid
+            assert master._mutation_log.flush()
+            logged = MutationLog.read(str(tmp_path / "state"))
+            assert ("coord/elastic-training/0", b"barrier") in logged
+            assert all(k != "dcn/g0/grads/1" for k, _ in logged)
+            client.close()
+        finally:
+            master.stop(grace_s=0.1)
+
+    def test_coord_tier_death_falls_back_to_main_tier(self, cp_ctx,
+                                                      tmp_path):
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.master.job_master import JobMaster
+
+        master = JobMaster(port=0, min_nodes=1, max_nodes=1,
+                           host="127.0.0.1")
+        master.prepare()
+        try:
+            client = MasterClient(master.addr, node_id=0,
+                                  coord_addr=master.coord_addr)
+            assert client.kv_set("dcn/g0/state", b"via-coord")
+            master._coord_server.stop(0)   # the tier alone dies
+            assert client.kv_get("dcn/g0/state") == b"via-coord"
+            assert client.kv_set("dcn/g0/state", b"via-main")
+            assert client.kv_get("dcn/g0/state") == b"via-main"
+            client.close()
+        finally:
+            master.stop(grace_s=0.1)
+
+    def test_coord_servicer_rejects_control_tier_requests(self):
+        from dlrover_tpu.master.coord_service import CoordServicer
+
+        servicer = CoordServicer(KVStoreService())
+        response = servicer.report(msg.GlobalStepReport(node_id=0,
+                                                        step=1))
+        assert not response.success
+        assert "not a coordination-tier" in response.reason
+        response = servicer.get(msg.TaskRequest(dataset_name="ds"))
+        assert not response.success
+
+
+# ---------------------------------------------------------------------------
+# bounded telemetry ingest
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryQueue:
+    def test_storm_drops_oldest_and_counts(self):
+        from dlrover_tpu.master.coord_service import TelemetryIngestQueue
+
+        gate = threading.Event()
+        seen = []
+
+        def slow_process(report):
+            gate.wait(5.0)
+            seen.append(report)
+
+        queue = TelemetryIngestQueue(slow_process, maxlen=4)
+        t0 = time.monotonic()
+        for i in range(12):
+            queue.push(i)
+        push_wall = time.monotonic() - t0
+        assert push_wall < 0.5, "push must never block on processing"
+        assert queue.dropped_total >= 7   # 12 pushed, 4 fit + in-flight
+        gate.set()
+        assert queue.flush(timeout_s=5.0)
+        queue.stop()
+        # the NEWEST reports survived (drop-oldest)
+        assert 11 in seen
+        rendered = obs.get_registry().render()
+        assert "dlrover_tpu_telemetry_dropped_total" in rendered
+
+    def test_servicer_report_returns_before_processing(self):
+        from dlrover_tpu.master.servicer import MasterServicer
+
+        servicer = MasterServicer()
+        response = servicer.report(msg.TelemetryReport(
+            node_id=3,
+            samples=[msg.MetricSample(kind="gauge",
+                                      name="cp_queue_gauge",
+                                      value=4.0, labels={"node": "3"})],
+        ))
+        assert response.success
+        assert servicer.telemetry_queue.flush(timeout_s=5.0)
+        assert 'cp_queue_gauge{node="3"} 4' in \
+            obs.get_registry().render()
+
+
+# ---------------------------------------------------------------------------
+# dcn_sync episode namespacing
+# ---------------------------------------------------------------------------
+
+
+class _FakeSyncClient:
+    def __init__(self, kv, status):
+        self.kv = kv
+        self.status = status
+
+    def kv_set(self, key, value):
+        self.kv[key] = value
+        return True
+
+    def kv_get(self, key):
+        return self.kv.get(key, b"")
+
+    def get_slice_status(self):
+        return json.loads(json.dumps(self.status))
+
+
+class TestDcnEpisodeNamespacing:
+    def _status(self, epoch=None):
+        status = {"total": 2, "fleet_step": 0,
+                  "slices": {"0": {"formed": True},
+                             "1": {"formed": True}}}
+        if epoch is not None:
+            status["epoch"] = epoch
+        return status
+
+    def test_epoch_aware_master_namespaces_every_key(self):
+        from dlrover_tpu.parallel.dcn_sync import (
+            SliceGradSync,
+            encode_leaves,
+        )
+
+        Context.singleton().update(dcn_sync_timeout_s=0.3,
+                                   dcn_sync_poll_s=0.01)
+        kv = {}
+        status = self._status(epoch=4)
+        s0 = SliceGradSync(_FakeSyncClient(kv, status), 0)
+        s1 = SliceGradSync(_FakeSyncClient(kv, status), 1)
+        out = {}
+        thread = threading.Thread(
+            target=lambda: out.update(
+                r1=s1.reduce([np.full((4,), 2.0, np.float32)], 1)))
+        thread.start()
+        reduced, info = s0.reduce([np.full((4,), 6.0, np.float32)], 1)
+        thread.join(timeout=10.0)
+        np.testing.assert_allclose(reduced[0], 4.0)
+        assert not info["degraded"]
+        assert set(kv) == {"dcn/g4/grads/0", "dcn/g4/grads/1"}
+        # a stale payload under the PREVIOUS epoch's namespace is
+        # unreachable by construction
+        kv["dcn/g3/grads/1"] = encode_leaves(
+            [np.full((4,), 99.0, np.float32)], 2)
+        status["epoch"] = 5
+        reduced2, info2 = s0.reduce(
+            [np.full((4,), 6.0, np.float32)], 2)
+        np.testing.assert_allclose(reduced2[0], 6.0)  # peer absent,
+        assert info2["degraded"]                      # never 99.0
+        Context.reset()
+
+    def test_legacy_master_without_epoch_keeps_legacy_keys(self):
+        from dlrover_tpu.parallel.dcn_sync import SliceGradSync
+
+        Context.singleton().update(dcn_sync_timeout_s=0.2,
+                                   dcn_sync_poll_s=0.01)
+        kv = {}
+        status = self._status(epoch=None)
+        status["slices"]["1"]["formed"] = False
+        s0 = SliceGradSync(_FakeSyncClient(kv, status), 0)
+        s0.reduce([np.full((4,), 1.0, np.float32)], 1)
+        assert "dcn/grads/0" in kv
+        Context.reset()
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar: shard-scoped faults
+# ---------------------------------------------------------------------------
+
+
+class TestShardChaos:
+    def test_parse_shard_faults(self):
+        from dlrover_tpu.diagnostics.chaos import parse_chaos
+
+        kill, hang = parse_chaos("kill:shard:1@5;hang:shard:0@3:2.5")
+        assert (kill.action, kill.role, kill.rank,
+                kill.at_step) == ("kill", "shard", 1, 5)
+        assert (hang.action, hang.role, hang.rank, hang.at_step,
+                hang.duration) == ("hang", "shard", 0, 3, 2.5)
+
+    def test_master_injector_arms_and_fires_shard_hooks(self, tmp_path,
+                                                        monkeypatch):
+        from dlrover_tpu.diagnostics.chaos import CHAOS_STATE_ENV
+        from dlrover_tpu.diagnostics.chaos import ChaosInjector
+
+        monkeypatch.setenv(CHAOS_STATE_ENV, str(tmp_path))
+        injector = ChaosInjector(
+            role="master", rank=0,
+            spec="kill:shard:1@5;hang:shard:0@5:2.0")
+        assert len(injector.faults) == 2
+        killed, wedged = [], []
+        injector.shard_kill_fn = killed.append
+        injector.shard_wedge_fn = lambda sid, s: wedged.append((sid, s))
+        injector.maybe_inject(4)
+        assert not killed and not wedged
+        injector.maybe_inject(5)
+        assert killed == [1] and wedged == [(0, 2.0)]
+        # one-shot: a respawned injector sees the markers
+        replay = ChaosInjector(role="master", rank=0,
+                               spec="kill:shard:1@5;hang:shard:0@5:2.0")
+        assert all(f.fired for f in replay.faults)
+
+    def test_worker_injector_ignores_shard_faults(self):
+        from dlrover_tpu.diagnostics.chaos import ChaosInjector
+
+        injector = ChaosInjector(role="worker", rank=1,
+                                 spec="kill:shard:1@5")
+        assert injector.faults == []
+
+    def test_jobmaster_chaos_kill_shard_end_to_end(self, cp_ctx,
+                                                   tmp_path,
+                                                   monkeypatch):
+        """kill:shard:0@3 through the real report path: a worker's
+        GlobalStepReport at step 3 restarts slice 0's shard; the state
+        survives, the peer shard never notices."""
+        from dlrover_tpu.diagnostics.chaos import CHAOS_ENV
+        from dlrover_tpu.diagnostics.chaos import CHAOS_STATE_ENV
+        from dlrover_tpu.master.job_master import JobMaster
+
+        monkeypatch.setenv(CHAOS_ENV, "kill:shard:0@3")
+        monkeypatch.setenv(CHAOS_STATE_ENV, str(tmp_path / "chaos"))
+        master = JobMaster(port=0, min_nodes=1, max_nodes=4,
+                           host="127.0.0.1")
+        master.prepare()
+        try:
+            mgr = master.rdzv_managers[RendezvousName.TRAINING]
+            _form(mgr, {0: 0, 1: 0, 2: 1, 3: 1})
+            survivor = mgr.get_comm_world(2)
+            master.servicer.report(msg.GlobalStepReport(
+                node_id=0, node_rank=0, step=3))
+            assert mgr.shard(0).restarts == 1
+            assert mgr.shard(1).restarts == 0
+            assert mgr.get_comm_world(0) == (0, 0, {0: 1, 1: 1})
+            assert mgr.get_comm_world(2) == survivor
+        finally:
+            master.stop(grace_s=0.1)
+
+
+# ---------------------------------------------------------------------------
+# hot-standby promotion: the acceptance drill
+# ---------------------------------------------------------------------------
+
+
+SLEEPER = [sys.executable, "-c", "import time; time.sleep(120)"]
+
+
+def _wait_for(predicate, timeout_s: float, what: str):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture()
+def standby_ctx(tmp_path):
+    ctx = Context.singleton()
+    ctx.update(
+        rpc_timeout_s=1.0,
+        rpc_retries=2,
+        rpc_backoff_s=0.02,
+        rpc_backoff_max_s=0.05,
+        master_reconnect_timeout_s=60.0,
+        master_state_dir=str(tmp_path / "state"),
+        master_bootstrap_file=str(tmp_path / "master.addr"),
+        standby_health_interval_s=0.25,
+        standby_promote_failures=2,
+    )
+    yield ctx
+    Context.reset()
+
+
+class TestStandbyPromotion:
+    def test_promotion_preserves_state_and_fences_old_primary(
+            self, standby_ctx, tmp_path):
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.master.job_master import JobMaster
+        from dlrover_tpu.master.standby import StandbyMaster
+
+        primary = JobMaster(port=0, min_nodes=2, max_nodes=2,
+                            host="127.0.0.1")
+        primary.prepare()
+        c0 = MasterClient(primary.addr, node_id=0)
+        c1 = MasterClient(primary.addr, node_id=1)
+        standby = StandbyMaster(state_dir=str(tmp_path / "state"),
+                                host="127.0.0.1",
+                                min_nodes=2, max_nodes=2)
+        try:
+            c0.join_rendezvous(local_world_size=4)
+            c1.join_rendezvous(local_world_size=4)
+            assert c0.get_comm_world()[2] == {0: 4, 1: 4}
+            c0.kv_set("coordinator", b"10.0.0.1:1")   # cold
+            # a hot coord/ barrier set AFTER the last cold snapshot:
+            # must survive promotion via the mutation-log tail
+            c0.kv_set("coord/elastic-training/0", b"hot-tail")
+            assert primary._mutation_log.flush()
+            standby.start()
+            _wait_for(lambda: standby.warm_version > 0, 10.0,
+                      "standby to warm from the snapshot stream")
+            assert standby.consecutive_failures == 0
+
+            # chaos-kill the primary: servers die, no graceful stop
+            primary._server.stop(0)
+            primary._coord_server.stop(0)
+            _wait_for(lambda: standby.promoted_master is not None,
+                      20.0, "standby promotion")
+            promoted = standby.promoted_master
+            assert promoted.generation == 2
+            # re-resolve like an agent in master-lost mode would
+            assert MasterClient.resolve_master_addr() == promoted.addr
+            c0.reconnect(MasterClient.resolve_master_addr())
+            # warm state: world intact, cold AND hot keys present
+            result = c0.reconnect_report(local_world_size=4,
+                                         rdzv_round=0)
+            assert result.world_intact
+            assert result.generation == 2
+            assert promoted.kv_store.get("coordinator") == \
+                b"10.0.0.1:1"
+            assert promoted.kv_store.get(
+                "coord/elastic-training/0") == b"hot-tail"
+            # bootstrap handoff carries the new generation
+            with open(str(tmp_path / "master.addr")) as f:
+                bootstrap = json.load(f)
+            assert bootstrap == {"addr": promoted.addr,
+                                 "coord_addr": promoted.coord_addr,
+                                 "generation": 2}
+            # a revived old primary is FENCED out of the file
+            primary._publish_bootstrap_addr()
+            with open(str(tmp_path / "master.addr")) as f:
+                assert json.load(f)["addr"] == promoted.addr
+            events = [e.get("name") for e in
+                      obs.get_flight_recorder().snapshot()]
+            assert "master_promoted" in events
+            assert "master_fenced" in events
+        finally:
+            c0.close()
+            c1.close()
+            standby.stop()
+            primary.stop(grace_s=0.1)
+
+    def test_fenced_primary_stops_writing_the_shared_lineage(
+            self, standby_ctx, tmp_path):
+        """A stale lower-generation master must stop BOTH snapshot and
+        mutation-log writes once a higher generation owns the bootstrap
+        file — interleaved writers would corrupt the promoted lineage
+        (a false promotion on a network blip leaves the old primary
+        alive and writing)."""
+        import json as json_mod
+
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.master.job_master import JobMaster
+
+        primary = JobMaster(port=0, min_nodes=1, max_nodes=1,
+                            host="127.0.0.1")
+        primary.prepare()
+        client = MasterClient(primary.addr, node_id=0)
+        try:
+            client.kv_set("pre-fence", b"1")
+            # a higher-generation master takes the bootstrap file over
+            boot = str(tmp_path / "master.addr")
+            with open(boot + ".tmp", "w") as f:
+                json_mod.dump({"addr": "10.0.0.9:1", "coord_addr": "",
+                               "generation": 99}, f)
+            os.replace(boot + ".tmp", boot)
+            primary._check_fenced(throttle_s=0.0)
+            versions = primary._state_backend.versions()[-1]
+            client.kv_set("post-fence-cold", b"2")   # would snapshot
+            client.kv_set("coord/elastic-training/9",
+                          b"hot")                    # would log
+            assert primary._state_backend.versions()[-1] == versions
+            primary._mutation_log.flush()
+            log = MutationLog.read(str(tmp_path / "state"))
+            assert all(k != "coord/elastic-training/9" for k, _ in log)
+            events = [e.get("name") for e in
+                      obs.get_flight_recorder().snapshot()]
+            assert "master_fenced" in events
+        finally:
+            client.close()
+            primary.stop(grace_s=0.1)
+
+    def test_fleet_rides_out_promotion_without_worker_restarts(
+            self, standby_ctx, tmp_path):
+        """The acceptance drill: chaos-killed primary -> the standby
+        promotes -> a 2-agent fleet keeps its workers (same pids), no
+        re-register storm (no new rendezvous joins, no worker spawns),
+        the master_lost -> master_promoted -> master_reconnected
+        (world_intact) flight sequence on record."""
+        from dlrover_tpu.agent.elastic_agent import (
+            ElasticAgent,
+            WorkerSpec,
+        )
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.master.job_master import JobMaster
+        from dlrover_tpu.master.standby import StandbyMaster
+
+        primary = JobMaster(port=0, min_nodes=2, max_nodes=2,
+                            host="127.0.0.1")
+        primary.prepare()
+        standby = StandbyMaster(state_dir=str(tmp_path / "state"),
+                                host="127.0.0.1",
+                                min_nodes=2, max_nodes=2)
+        agents = []
+        try:
+            for rank in (0, 1):
+                client = MasterClient(primary.addr, node_id=rank)
+                spec = WorkerSpec(
+                    entrypoint=SLEEPER, devices_per_node=1,
+                    max_restarts=0, monitor_interval_s=0.1,
+                    rdzv_timeout_s=15.0, shutdown_grace_s=5.0,
+                    enable_monitors=False, master_lost_after_polls=2,
+                )
+                agents.append(ElasticAgent(client, spec))
+            for agent in agents:
+                threading.Thread(target=agent.run, daemon=True).start()
+            _wait_for(
+                lambda: all(a.last_round == 0 and a._proc is not None
+                            for a in agents),
+                15.0, "initial rendezvous + worker spawn")
+            pids = [a._proc.pid for a in agents]
+            standby.start()
+            _wait_for(lambda: standby.warm_version > 0, 10.0,
+                      "standby warm")
+
+            kill_ts = time.time()
+            primary._server.stop(0)           # chaos kill
+            primary._coord_server.stop(0)
+            _wait_for(lambda: standby.promoted_master is not None,
+                      20.0, "promotion")
+            promoted = standby.promoted_master
+            _wait_for(
+                lambda: all(
+                    a._client.master_addr == promoted.addr
+                    and a._client.master_generation == 2
+                    for a in agents),
+                30.0, "agents to reconnect to the promoted master")
+            # zero worker restarts: same pids, still alive
+            time.sleep(0.5)
+            assert [a._proc.pid for a in agents] == pids
+            assert all(a._proc.poll() is None for a in agents)
+            # the promoted master's coordination tier was re-learned
+            assert all(a._client.coord_addr == promoted.coord_addr
+                       for a in agents)
+
+            events = obs.get_flight_recorder().snapshot()
+            by_name = {}
+            for event in events:
+                if event.get("ts", 0.0) >= kill_ts:
+                    by_name.setdefault(event.get("name"),
+                                       []).append(event)
+            assert by_name.get("master_lost"), "agents never noticed"
+            promoted_events = by_name.get("master_promoted")
+            assert promoted_events and len(promoted_events) == 1
+            reconnected = by_name.get("master_reconnected", [])
+            assert len(reconnected) >= 2
+            assert all(e["attrs"]["world_intact"]
+                       for e in reconnected)
+            # the ordering: lost -> promoted -> reconnected
+            assert (max(e["ts"] for e in by_name["master_lost"])
+                    <= max(e["ts"] for e in reconnected))
+            assert (promoted_events[0]["ts"]
+                    <= max(e["ts"] for e in reconnected))
+            # no re-register storm: nobody re-joined rendezvous, no
+            # worker was spawned after the kill
+            assert "worker_spawn" not in by_name
+            assert not [
+                e for e in events
+                if e.get("kind") == "span"
+                and e.get("name") == "rendezvous_join"
+                and e.get("ts", 0.0) >= kill_ts]
+        finally:
+            for agent in agents:
+                agent.shutdown()
+                agent._client.close()
+            standby.stop()
+            primary.stop(grace_s=0.1)
+
+
+# ---------------------------------------------------------------------------
+# tools/diagnose.py: control-plane topology section
+# ---------------------------------------------------------------------------
+
+
+def test_diagnose_renders_controlplane_section(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import diagnose
+    finally:
+        sys.path.pop(0)
+    payload = {"events": [
+        {"kind": "event", "name": "standby_started", "ts": 1.0,
+         "attrs": {"state_dir": "/s"}},
+        {"kind": "event", "name": "shard_wedged", "ts": 2.0,
+         "attrs": {"slice": 0, "seconds": 3.0}},
+        {"kind": "event", "name": "shard_restarted", "ts": 3.0,
+         "attrs": {"slice": 0, "restarts": 1}},
+        {"kind": "event", "name": "master_promoted", "ts": 9.0,
+         "attrs": {"addr": "10.0.0.2:9", "generation": 3,
+                   "snapshot_version": 12, "failed_probes": 3,
+                   "promotion_s": 0.02}},
+        {"kind": "event", "name": "master_fenced", "ts": 11.0,
+         "attrs": {"file_generation": 3, "our_generation": 2}},
+    ]}
+    rendered = diagnose.render_controlplane(payload)
+    assert "control-plane events: 5" in rendered
+    assert "master_promoted" in rendered
+    assert "shard 0: wedged x1, restarted x1" in rendered
+    assert ("promotion: generation 3 at 10.0.0.2:9 from snapshot v12 "
+            "in 0.02s after 3 failed probes") in rendered
+    assert "master_fenced" in rendered
+
+
+# ---------------------------------------------------------------------------
+# CI: the control-plane bench smoke run (numbers land in CI artifacts)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_controlplane_smoke(tmp_path):
+    out = str(tmp_path / "cp.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_controlplane.py"),
+         "--smoke", "--ranks", "128", "--slices", "8",
+         "--kv-ops", "200", "--json", out],
+        capture_output=True, text=True, timeout=500)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out) as f:
+        result = json.load(f)
+    joins = result["joins"]
+    assert joins["sharded"]["joins_per_s"] > 0
+    assert joins["single_lock"]["joins_per_s"] > 0
+    # the headline claim, with CI headroom (full runs measure >= 2x at
+    # 1k ranks; see docs/fault_tolerance.md)
+    assert joins["speedup"] >= 1.3, joins
+    reform = result["reform_ms"]["sharded"]
+    # per-slice time-to-reform stays flat as slice count grows
+    values = [reform[k] for k in sorted(reform, key=int)]
+    assert max(values) < 10 * max(1.0, min(values)), reform
+    assert result["kv"]["get_ops_per_s"] > \
+        result["kv"]["set_ops_per_s"]
+
+
+# ---------------------------------------------------------------------------
+# CI gate: graftlint clean on every new/changed module
+# ---------------------------------------------------------------------------
+
+
+def test_graftlint_clean_on_controlplane_modules():
+    from dlrover_tpu.analysis import run_analysis
+
+    result = run_analysis([
+        os.path.join(REPO, "dlrover_tpu", "master",
+                     "rendezvous_shards.py"),
+        os.path.join(REPO, "dlrover_tpu", "master", "coord_service.py"),
+        os.path.join(REPO, "dlrover_tpu", "master", "standby.py"),
+        os.path.join(REPO, "dlrover_tpu", "master", "kv_store.py"),
+        os.path.join(REPO, "dlrover_tpu", "master", "state_backend.py"),
+        os.path.join(REPO, "dlrover_tpu", "master", "job_master.py"),
+        os.path.join(REPO, "dlrover_tpu", "master", "servicer.py"),
+        os.path.join(REPO, "dlrover_tpu", "master", "rendezvous.py"),
+        os.path.join(REPO, "dlrover_tpu", "agent", "master_client.py"),
+        os.path.join(REPO, "dlrover_tpu", "parallel", "dcn_sync.py"),
+        os.path.join(REPO, "dlrover_tpu", "diagnostics", "chaos.py"),
+    ])
+    assert result.findings == [], [str(f) for f in result.findings]

@@ -97,9 +97,13 @@ class TestStateSurvivesMasterRestart:
             mgr = master2.rdzv_managers[RendezvousName.TRAINING]
             assert mgr.rdzv_round == 1
             assert mgr.latest_world == {0: 4, 1: 4}
-            # bootstrap file advertises the NEW master
+            # bootstrap file advertises the NEW master (JSON since the
+            # hot-standby work: addr + coord tier + generation fencing)
             with open(str(tmp_path / "master.addr")) as f:
-                assert f.read().strip() == master2.addr
+                bootstrap = json.load(f)
+            assert bootstrap["addr"] == master2.addr
+            assert bootstrap["coord_addr"] == master2.coord_addr
+            assert bootstrap["generation"] == 2
 
             # 4 shards: 1 done, 2 in flight, 1 never dispatched
             assert master2.task_manager.counts("ds") == (1, 2)

@@ -90,6 +90,7 @@ def test_servicer_drops_malformed_remote_sample():
                                   value=2.0, labels={"node": "1"})],
     ))
     assert response.success            # report path survives
+    assert servicer.telemetry_queue.flush(timeout_s=5.0)
     rendered = obs.get_registry().render()
     assert "bad name" not in rendered  # malformed family never registered
     assert 'good_after_bad{node="1"} 2' in rendered
@@ -270,6 +271,9 @@ def test_servicer_ingests_telemetry_report():
         spans_json=json.dumps(spans),
     ))
     assert response.success
+    # ingestion rides a bounded queue + drainer thread since the
+    # control-plane split; flush before asserting on the registry
+    assert servicer.telemetry_queue.flush(timeout_s=5.0)
     rendered = obs.get_registry().render()
     assert 'obs_test_worker_gauge{node="7"} 1.5' in rendered
     assert 'obs_test_total{node="7"} 2' in rendered
@@ -301,6 +305,7 @@ def test_master_client_report_telemetry_roundtrip(free_port):
         client.close()
     finally:
         server.stop(0.1)
+    assert servicer.telemetry_queue.flush(timeout_s=5.0)
     rendered = obs.get_registry().render()
     assert 'obs_rpc_gauge{node="3"} 9' in rendered
 

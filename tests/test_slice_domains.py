@@ -362,7 +362,8 @@ class TestSliceRendezvous:
         mgr.join_rendezvous(1, 1)
         round_idx, group, world = mgr.get_comm_world(0)
         assert (round_idx, group, world) == (0, 0, {0: 1, 1: 1})
-        assert mgr.slice_status() == {"total": 0, "slices": {}}
+        assert mgr.slice_status() == {"total": 0, "slices": {},
+                              "epoch": 0}
 
     def test_network_check_ignores_slices(self):
         mgr = NetworkCheckRendezvousManager(_params())

@@ -190,6 +190,63 @@ def render_slices(payload: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# flight events describing the control plane's own topology + lifecycle
+# (master/rendezvous_shards.py, master/standby.py, master/job_master.py)
+_CONTROLPLANE_EVENTS = (
+    "standby_started", "master_promoted", "master_fenced",
+    "master_restore", "master_lost", "master_reconnected",
+    "shard_wedged", "shard_restarted",
+)
+
+
+def render_controlplane(payload: Dict[str, Any]) -> str:
+    """Control-plane topology + failover section: shard kills/wedges,
+    master restores, standby promotions (with generation tokens and
+    promotion latency) and double-primary fencing — the one-glance
+    answer to "who is the primary now, how did it get there, and which
+    rendezvous shards have been through what?"."""
+    events = [record for record in payload.get("events", [])
+              if record.get("kind") == "event"
+              and record.get("name") in _CONTROLPLANE_EVENTS]
+    lines = [f"control-plane events: {len(events)}"]
+    if not events:
+        return "\n".join(lines)
+    ordered = sorted(events, key=lambda e: e.get("ts", 0.0))
+    t0 = ordered[0].get("ts", 0.0)
+    shard_history: Dict[Any, Dict[str, int]] = {}
+    promotions = []
+    for record in ordered:
+        attrs = dict(record.get("attrs", {}))
+        name = str(record.get("name", "?"))
+        if name in ("shard_wedged", "shard_restarted"):
+            stats = shard_history.setdefault(
+                attrs.get("slice"), {"wedged": 0, "restarted": 0})
+            stats["wedged" if name == "shard_wedged"
+                  else "restarted"] += 1
+        if name == "master_promoted":
+            promotions.append(attrs)
+        detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        lines.append("+{offset:8.1f}s  {name:<26} {detail}".format(
+            offset=record.get("ts", 0.0) - t0,
+            name=name, detail=detail).rstrip())
+    for sid in sorted(shard_history, key=str):
+        stats = shard_history[sid]
+        lines.append(
+            f"  shard {sid}: wedged x{stats['wedged']}, "
+            f"restarted x{stats['restarted']} (other shards kept "
+            f"serving throughout)")
+    for attrs in promotions:
+        lines.append(
+            "  promotion: generation {gen} at {addr} from snapshot "
+            "v{ver} in {took}s after {probes} failed probes".format(
+                gen=attrs.get("generation", "?"),
+                addr=attrs.get("addr", "?"),
+                ver=attrs.get("snapshot_version", "?"),
+                took=attrs.get("promotion_s", "?"),
+                probes=attrs.get("failed_probes", "?")))
+    return "\n".join(lines)
+
+
 # flight events describing an online parallelism re-plan
 # (parallel/planner.py + master/rendezvous.py + trainer/elastic_loop.py)
 _REPLAN_EVENTS = (
@@ -358,6 +415,7 @@ def main(argv=None) -> int:
         print(render_lifecycle(payload))
         print(render_restore(payload))
         print(render_slices(payload))
+        print(render_controlplane(payload))
         print(render_replans(payload))
         print(render_goodput(payload))
     for path in ns.timeline:
