@@ -200,6 +200,12 @@ def run_bench() -> dict:
         headline = scenarios["shrink"]["replan"][
             "time_to_first_step_s"]
         snap = master.goodput_ledger.snapshot()
+        # the prediction<->measurement loop, benchmarked not asserted:
+        # every plan this run stamped with the planner's predicted step
+        # time beside the steady-state measured one (parallel/
+        # calibration.py; >= 2 distinct mesh shapes — base, shrink,
+        # grow — each with its own predicted-vs-measured row)
+        calibration = master.plan_calibration.table()
         return {
             "metric": "replan_time_to_first_step_seconds",
             "value": headline,
@@ -211,6 +217,9 @@ def run_bench() -> dict:
             "scenarios": scenarios,
             "replans_priced": snap.get("replans", []),
             "goodput_fraction": snap.get("goodput_fraction", 0.0),
+            "calibration": calibration,
+            "axis_discounts": master.plan_calibration.axis_discounts(
+                min_samples=1),
             "workdir": workdir,
         }
     finally:

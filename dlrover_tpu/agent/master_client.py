@@ -425,21 +425,13 @@ class MasterClient:
         mark ({} = no slice registry / master predates it) — the
         cross-slice gradient sync's present set
         (parallel/dcn_sync.py)."""
-        import json
-
         # per-step traffic: the coordination tier answers when split out
         result = self._typed(
             lambda request: self._coord_send("get", request),
             msg.SliceStatusRequest(
                 node_id=self.node_id, node_rank=self.node_rank,
                 rdzv_name=rdzv_name), msg.SliceStatus)
-        if not result.status_json:
-            return {}
-        try:
-            status = json.loads(result.status_json)
-        except json.JSONDecodeError:
-            return {}
-        return status if isinstance(status, dict) else {}
+        return self._json_dict(result.status_json)
 
     @retry_rpc(retries=3)
     def get_shard_plan(self, rdzv_name: str = RendezvousName.TRAINING
@@ -546,19 +538,29 @@ class MasterClient:
     def report_global_step(self, step: int, step_time_s: float = 0.0,
                            data_wait_fraction: float = -1.0,
                            mfu: float = -1.0,
-                           degraded_steps: int = 0) -> bool:
+                           degraded_steps: int = 0,
+                           hbm_peak_bytes: float = 0.0,
+                           plan_generation: int = -1) -> bool:
         """Step progress, optionally with the sender's windowed speed
         evidence (mean step wall time + data-wait fraction from the
         worker's phase timeline, achieved MFU from its FLOPs model) —
         the diagnosis engine's straggler / data-bound / collapse
         input and the goodput ledger's productive-time accrual.
         ``degraded_steps``: steps in this window the sender's slice
-        took with a renormalized (peer-slice-absent) gradient mean."""
+        took with a renormalized (peer-slice-absent) gradient mean.
+        ``hbm_peak_bytes``: the window's device-truth HBM allocator
+        peak (obs/device.py; 0 = backend has no memory stats).
+        ``plan_generation``: the applied shard plan's generation —
+        calibration attributes the timing evidence by it (-1 =
+        unknown, -2 = running the fallback mesh, see
+        GlobalStepReport)."""
         return self._report(msg.GlobalStepReport(
             node_id=self.node_id, step=step, timestamp=time.time(),
             node_rank=self.node_rank, step_time_s=step_time_s,
             data_wait_fraction=data_wait_fraction, mfu=mfu,
             degraded_steps=degraded_steps,
+            hbm_peak_bytes=hbm_peak_bytes,
+            plan_generation=plan_generation,
         )).success
 
     # -- diagnosis --------------------------------------------------------
@@ -655,19 +657,46 @@ class MasterClient:
             effective_global_batch=effective_global_batch,
         )).success
 
-    def get_goodput(self, window_s: float = 0.0) -> dict:
-        """The master's goodput-ledger snapshot (tools/goodput.py)."""
+    @staticmethod
+    def _json_dict(text: str) -> dict:
+        """A JSON-dict RPC payload field, or {} — the shared contract
+        of every "{} = master predates this" JSON-carrying result."""
         import json
 
-        result = self._get_typed(msg.GoodputRequest(window_s=window_s),
-                                 msg.GoodputReport)
-        if not result.report_json:
+        if not text:
             return {}
         try:
-            snap = json.loads(result.report_json)
+            payload = json.loads(text)
         except json.JSONDecodeError:
             return {}
-        return snap if isinstance(snap, dict) else {}
+        return payload if isinstance(payload, dict) else {}
+
+    def query_timeseries(self, name: str = "", labels=None,
+                         window_s: float = 0.0,
+                         resolution_s: float = 0.0) -> dict:
+        """Windowed, aligned history from the master's time-series
+        store (obs/tsdb.py): {"series": [...], "tiers": [...],
+        "stats": {...}} — or {"names": [...]} with an empty name.
+        {} = master predates the store / store disabled."""
+        result = self._get_typed(msg.TimeSeriesQuery(
+            name=name, labels=dict(labels or {}),
+            window_s=window_s, resolution_s=resolution_s),
+            msg.TimeSeriesResult)
+        return self._json_dict(result.result_json)
+
+    def get_plan_calibration(self) -> dict:
+        """The planner calibration table + learned axis discounts
+        (parallel/calibration.py): {"table": [...], "discounts": {}}.
+        {} = master predates calibration."""
+        result = self._get_typed(msg.PlanCalibrationRequest(),
+                                 msg.PlanCalibrationReport)
+        return self._json_dict(result.report_json)
+
+    def get_goodput(self, window_s: float = 0.0) -> dict:
+        """The master's goodput-ledger snapshot (tools/goodput.py)."""
+        result = self._get_typed(msg.GoodputRequest(window_s=window_s),
+                                 msg.GoodputReport)
+        return self._json_dict(result.report_json)
 
     def report_telemetry(self, samples=None, spans=None) -> bool:
         """Push metric samples + finished span dicts to the master's

@@ -28,17 +28,26 @@ from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.config import Context
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.obs.device import _RISE_THRESHOLD_BYTES
 
 
 def report_step(step: int, path: Optional[str] = None,
                 step_time_s: float = 0.0,
-                data_wait_fraction: float = -1.0) -> None:
+                data_wait_fraction: float = -1.0,
+                plan_generation: int = -1) -> None:
     """Called from the TRAINING process each step (or every k steps).
     Atomic single-record write: readers only ever need the latest record,
     and week-long jobs must not grow the file unboundedly. The optional
     timing fields (windowed mean step time + data-wait fraction, from
     the phase timeline) ride along so the agent's TrainingMonitor can
-    forward the diagnosis engine's straggler evidence."""
+    forward the diagnosis engine's straggler evidence.
+    ``plan_generation``: the shard-plan generation the trainer actually
+    applied (parallel/planner.py) — forwarded so the master's plan
+    calibration attributes this timing to the right mesh shape; -1 =
+    sender does not track plans (calibration falls back to
+    current-signature attribution); -2 = sender ran a fallback mesh
+    (the master DROPS the evidence — it must ride the relay, not
+    collapse into -1's current-shape attribution)."""
     path = path or os.environ.get(NodeEnv.METRICS_FILE, "")
     if not path:
         return
@@ -47,6 +56,8 @@ def report_step(step: int, path: Optional[str] = None,
         record["step_time_s"] = float(step_time_s)
     if data_wait_fraction >= 0.0:
         record["data_wait_fraction"] = float(data_wait_fraction)
+    if plan_generation != -1:
+        record["plan_generation"] = int(plan_generation)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write(json.dumps(record) + "\n")
@@ -155,6 +166,13 @@ class ResourceMonitor:
 # to derive busy time from. One training process = one exporter, so a
 # module-level cell (no lock: only the step loop calls this) suffices.
 _chip_export_prev: dict = {}
+# last exported peak_bytes_in_use per (path, device): the allocator
+# counter is lifetime-monotone (obs/device.py), so the export must
+# window it — relaying the raw counter would latch HbmPressureRule on
+# a long-resolved spike forever. Same noise threshold as the step-
+# report path, imported so the two windowings cannot drift.
+_chip_export_peaks: dict = {}
+_PEAK_RISE_BYTES = _RISE_THRESHOLD_BYTES
 
 
 def export_chip_stats(path: Optional[str] = None,
@@ -188,13 +206,34 @@ def export_chip_stats(path: Optional[str] = None,
     if step is not None:
         _chip_export_prev[path] = {"ts": now, "step": int(step)}
     stats = []
+    peaks = _chip_export_peaks.setdefault(path, {})
     for device in jax.local_devices():
-        mem = device.memory_stats() or {}
-        chip = {
-            "index": device.id,
-            "hbm_used_mb": mem.get("bytes_in_use", 0) / (1 << 20),
-            "hbm_total_mb": mem.get("bytes_limit", 0) / (1 << 20),
-        }
+        try:
+            mem = device.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — backend support varies
+            mem = {}
+        chip = {"index": device.id}
+        if mem:
+            # hbm fields only when the backend actually answered: a CPU
+            # backend's absent memory_stats used to export hbm_used_mb=0
+            # forever — a 0 % series dashboards read as real headroom
+            # instead of an honest absence
+            chip["hbm_used_mb"] = mem.get("bytes_in_use", 0) / (1 << 20)
+            chip["hbm_total_mb"] = mem.get("bytes_limit", 0) / (1 << 20)
+            # the allocator's peak high-water mark: the transient
+            # IN-step peak the between-steps bytes_in_use sample misses
+            # (obs/device.py; what HbmPressureRule should judge).
+            # Exported only when it ROSE since the last export — the
+            # counter never resets within a process, so relaying it
+            # unconditionally would keep a long-resolved spike in
+            # HbmPressureRule's evidence forever; between rises,
+            # hbm_used_mb is the honest live signal (the same
+            # windowing DeviceTelemetry applies to the step report)
+            peak = float(mem.get("peak_bytes_in_use", 0) or 0)
+            prev_peak = peaks.get(device.id, 0.0)
+            if peak > prev_peak + _PEAK_RISE_BYTES:
+                chip["hbm_peak_mb"] = peak / (1 << 20)
+            peaks[device.id] = max(peak, prev_peak)
         if duty is not None:
             chip["duty_cycle_pct"] = duty
         stats.append(chip)
@@ -246,6 +285,8 @@ class TrainingMonitor:
                             record.get("step_time_s", 0.0) or 0.0),
                         data_wait_fraction=float(
                             record.get("data_wait_fraction", -1.0)),
+                        plan_generation=int(
+                            record.get("plan_generation", -1)),
                     )
                 except Exception as e:  # noqa: BLE001
                     logger.warning("step report failed: %s", e)

@@ -123,6 +123,28 @@ class Context:
         self.goodput_min_coverage: float = (
             DefaultValues.GOODPUT_MIN_COVERAGE
         )
+        # fleet time-series plane (obs/tsdb.py): master-side history
+        # store sampling + sidecar-persistence cadences
+        self.tsdb_sample_interval_s: float = (
+            DefaultValues.TSDB_SAMPLE_INTERVAL_S
+        )
+        self.tsdb_flush_interval_s: float = (
+            DefaultValues.TSDB_FLUSH_INTERVAL_S
+        )
+        # planner calibration (parallel/calibration.py) + the
+        # PlanRegressionRule thresholds (master/diagnosis/rules.py)
+        self.calibration_min_samples: int = (
+            DefaultValues.CALIBRATION_MIN_SAMPLES
+        )
+        self.plan_regression_ratio: float = (
+            DefaultValues.PLAN_REGRESSION_RATIO
+        )
+        self.plan_regression_windows: int = (
+            DefaultValues.PLAN_REGRESSION_WINDOWS
+        )
+        self.plan_regression_clear_windows: int = (
+            DefaultValues.PLAN_REGRESSION_CLEAR_WINDOWS
+        )
         self.seconds_per_scale_check: float = (
             DefaultValues.SECONDS_PER_SCALE_CHECK
         )

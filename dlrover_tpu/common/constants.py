@@ -344,6 +344,27 @@ class DefaultValues:
     # window) before the rule judges it — a freshly-started world's
     # first half-window is not evidence of lost goodput
     GOODPUT_MIN_COVERAGE = 0.5
+    # -- fleet time-series plane (obs/tsdb.py) --------------------------
+    # cadence the master's collector samples the allowlisted registry
+    # gauges + goodput snapshot into the history store; 0 = no sampler
+    # thread (direct step-report ingest still runs)
+    TSDB_SAMPLE_INTERVAL_S = 5.0
+    # cadence the downsampled tiers persist to the state-dir sidecar
+    # (bounded history loss on a hard master kill); 0 = flush only on
+    # graceful stop
+    TSDB_FLUSH_INTERVAL_S = 30.0
+    # -- planner calibration (parallel/calibration.py) ------------------
+    # measurements a plan signature needs before it is calibration
+    # evidence (each sample is already a windowed worker mean)
+    CALIBRATION_MIN_SAMPLES = 3
+    # PlanRegressionRule: alert when measured step time exceeds the
+    # planner's prediction by this ratio for PLAN_REGRESSION_WINDOWS
+    # consecutive diagnosis rounds (hysteresis like StragglerRule);
+    # clears after PLAN_REGRESSION_CLEAR_WINDOWS under it. ratio 0 =
+    # rule disabled.
+    PLAN_REGRESSION_RATIO = 1.5
+    PLAN_REGRESSION_WINDOWS = 3
+    PLAN_REGRESSION_CLEAR_WINDOWS = 2
     # -- preemption-aware graceful drain (agent/preemption.py) ----------
     # grace window assumed when a notice carries no deadline (a bare
     # SIGTERM): k8s default terminationGracePeriodSeconds

@@ -482,6 +482,11 @@ class ChipStats(Message):
     duty_cycle_pct: float = -1.0
     hbm_used_mb: float = 0.0
     hbm_total_mb: float = 0.0
+    # allocator peak high-water mark (memory_stats peak_bytes_in_use,
+    # obs/device.py): the IN-step transient the between-steps
+    # bytes_in_use sample misses — what HbmPressureRule judges. < 0 =
+    # unknown / sender predates the field.
+    hbm_peak_mb: float = -1.0
 
 
 @dataclass
@@ -535,6 +540,19 @@ class GlobalStepReport(Message):
     # mode (gradient mean renormalized over present slices while a peer
     # slice was absent, parallel/dcn_sync.py). 0 = none / predates.
     degraded_steps: int = 0
+    # device-truth HBM peak watermark over the report window
+    # (obs/device.py: jax memory_stats peak-bytes — the transient
+    # IN-step peak, not the between-steps trough). 0 = backend has no
+    # memory stats (CPU) / sender predates the field.
+    hbm_peak_bytes: float = 0.0
+    # the generation of the shard plan the sender's loop ACTUALLY
+    # applied (parallel/calibration.py attributes the timing evidence
+    # by this, so an old incarnation's straggling report can never
+    # land on a shape it did not run). >= 0 = a stamped plan's
+    # generation; -1 = sender predates the field (the master falls
+    # back to current-signature attribution); -2 = sender is running
+    # the replan FALLBACK mesh (not the stamped plan — dropped).
+    plan_generation: int = -1
 
 
 @dataclass
@@ -715,6 +733,43 @@ class DiagnosisReportRequest(Message):
 @dataclass
 class DiagnosisReports(Message):
     reports_json: str = ""       # JSON list of DiagnosisReport dicts
+
+
+@dataclass
+class TimeSeriesQuery(Message):
+    """tools/top.py (or any scraper) asking the master's time-series
+    store (obs/tsdb.py) for windowed, aligned history. ``name`` may end
+    with ``*`` for a prefix match; "" lists available series names.
+    ``labels`` is a subset filter; ``resolution_s`` 0 = auto (raw when
+    it covers the window, else the finest covering tier)."""
+
+    name: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    window_s: float = 0.0
+    resolution_s: float = 0.0
+
+
+@dataclass
+class TimeSeriesResult(Message):
+    """JSON TimeSeriesStore.query_payload dict: {"series": [...],
+    "tiers": [...], "stats": {...}} (or {"names": [...]} for a listing).
+    "" = master has no time-series store."""
+
+    result_json: str = ""
+
+
+@dataclass
+class PlanCalibrationRequest(Message):
+    """The planner calibration table (parallel/calibration.py):
+    predicted vs measured step time / MFU per applied shard-plan
+    signature, plus the learned per-axis discounts."""
+
+    pass
+
+
+@dataclass
+class PlanCalibrationReport(Message):
+    report_json: str = ""        # JSON {"table": [...], "discounts": {}}
 
 
 @dataclass

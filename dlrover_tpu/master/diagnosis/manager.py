@@ -55,12 +55,16 @@ _STATS_FRESH_S = 120.0
 
 class DiagnosisManager:
     def __init__(self, speed_monitor, rules: Optional[List[Rule]] = None,
-                 goodput_ledger=None):
+                 goodput_ledger=None, plan_calibration=None):
         self._speed_monitor = speed_monitor
         self._rules = rules if rules is not None else default_rules()
         # optional goodput ledger (obs/goodput.py): its trailing-window
         # summary rides on every snapshot as the GoodputRule's evidence
         self._goodput_ledger = goodput_ledger
+        # optional planner calibration (parallel/calibration.py): the
+        # running plan's predicted-vs-measured entry is the
+        # PlanRegressionRule's evidence
+        self._plan_calibration = plan_calibration
         self._lock = threading.Lock()
         self._diag_lock = threading.Lock()
         self._reports: deque = deque(maxlen=_REPORT_RING)
@@ -74,6 +78,12 @@ class DiagnosisManager:
         # crash-consistency hook (JobMaster wires _maybe_snapshot): new
         # reports should survive a master restart
         self.state_sink: Optional[callable] = None
+        # calibration feedback hook (JobMaster wires the servicer's
+        # push_axis_discounts): the learned discounts are recomputed on
+        # THIS loop's cadence, not per step report — the medians only
+        # move as samples accumulate, and the per-report path must stay
+        # appends-only
+        self.discount_sink: Optional[callable] = None
         registry = obs.get_registry()
         self._reports_total = registry.counter(
             "dlrover_tpu_diagnosis_reports_total",
@@ -99,6 +109,12 @@ class DiagnosisManager:
             "dlrover_tpu_worker_mfu",
             "Windowed per-rank achieved model-FLOPs utilization (from "
             "step reports; absent without a FLOPs model)",
+            labelnames=("node", "slice"))
+        self._hbm_peak_gauge = registry.gauge(
+            "dlrover_tpu_worker_hbm_peak_mb",
+            "Per-rank device-truth HBM peak watermark over the last "
+            "report window (in-step transient, obs/device.py; absent "
+            "on backends with no memory stats)",
             labelnames=("node", "slice"))
 
     # -- slice membership (multi-slice hierarchical DP) --------------------
@@ -128,11 +144,39 @@ class DiagnosisManager:
             "chips": [{"index": c.index,
                        "duty_cycle_pct": c.duty_cycle_pct,
                        "hbm_used_mb": c.hbm_used_mb,
-                       "hbm_total_mb": c.hbm_total_mb}
+                       "hbm_total_mb": c.hbm_total_mb,
+                       "hbm_peak_mb": getattr(c, "hbm_peak_mb", -1.0)}
                       for c in stats.chip_stats],
         }
         with self._lock:
+            # a fresher step-report watermark must survive the slower
+            # chip-stats relay overwriting the entry — but it carries
+            # its OWN age: a wedged loop (no step reports) keeps the
+            # chip relay alive, and its last watermark must expire
+            # with the window it described, not ride the relay's ts
+            previous = self._node_stats.get(rank)
+            if previous and previous.get("hbm_peak_mb", -1.0) >= 0.0:
+                peak_ts = float(previous.get("hbm_peak_ts", 0.0))
+                if time.time() - peak_ts <= _STATS_FRESH_S:
+                    entry["hbm_peak_mb"] = previous["hbm_peak_mb"]
+                    entry["hbm_peak_ts"] = peak_ts
             self._node_stats[rank] = entry
+
+    def observe_step_watermark(self, rank: int, peak_mb: float) -> None:
+        """Device-truth HBM peak watermark from a step report
+        (GlobalStepReport.hbm_peak_bytes → servicer): report-interval
+        cadence, the in-step transient — HbmPressureRule's preferred
+        signal over the between-steps chip-stats sample."""
+        if peak_mb < 0.0:
+            return
+        with self._lock:
+            entry = self._node_stats.get(rank)
+            if entry is None:
+                entry = {"ts": time.time(), "chips": []}
+                self._node_stats[rank] = entry
+            entry["hbm_peak_mb"] = float(peak_mb)
+            entry["hbm_peak_ts"] = time.time()
+            entry["ts"] = time.time()
 
     def observe_worker_exit(self, rank: int, exit_kind: str,
                             detail: str = "") -> None:
@@ -257,6 +301,12 @@ class DiagnosisManager:
                     Context.singleton().goodput_window_s)
             except Exception:  # noqa: BLE001 — evidence, not the chain
                 logger.exception("goodput window summary failed")
+        calibration = None
+        if self._plan_calibration is not None:
+            try:
+                calibration = self._plan_calibration.current()
+            except Exception:  # noqa: BLE001 — evidence, not the chain
+                logger.exception("plan calibration read failed")
         return DiagnosisSnapshot(
             ts=now,
             worker_speeds=self._speed_monitor.worker_speeds(),
@@ -267,6 +317,7 @@ class DiagnosisManager:
             running_mfu=self._speed_monitor.running_mfu(),
             peak_mfu=self._speed_monitor.peak_mfu(),
             goodput=goodput,
+            plan_calibration=calibration,
         )
 
     def diagnose_once(self) -> List[DiagnosisReport]:
@@ -290,6 +341,13 @@ class DiagnosisManager:
                 self.state_sink()
             except Exception:  # noqa: BLE001 — durability is best-effort
                 logger.exception("diagnosis state snapshot failed")
+        if self._plan_calibration is not None \
+                and self.discount_sink is not None:
+            try:
+                self.discount_sink(
+                    self._plan_calibration.axis_discounts())
+            except Exception:  # noqa: BLE001 — advisory feedback
+                logger.exception("axis discount push failed")
         return reports
 
     def _publish_worker_gauges(self, snap: DiagnosisSnapshot,
@@ -318,6 +376,13 @@ class DiagnosisManager:
                 self._mfu_gauge.labels(node=node, slice=slice_).set(
                     speed.mfu)
                 published.add((node, slice_))
+        for rank, stats in snap.node_stats.items():
+            peak = float(stats.get("hbm_peak_mb", -1.0) or -1.0)
+            if peak >= 0.0:
+                node, slice_ = _key(rank)
+                self._hbm_peak_gauge.labels(node=node,
+                                            slice=slice_).set(peak)
+                published.add((node, slice_))
         with self._lock:
             stale = self._published_scores - published
             self._published_scores = published
@@ -326,6 +391,7 @@ class DiagnosisManager:
             self._score_gauge.remove(node=node, slice=slice_)
             self._wait_gauge.remove(node=node, slice=slice_)
             self._mfu_gauge.remove(node=node, slice=slice_)
+            self._hbm_peak_gauge.remove(node=node, slice=slice_)
 
     def _emit(self, report: DiagnosisReport, ctx: Context) -> None:
         record = report.to_dict()

@@ -63,6 +63,11 @@ class DiagnosisSnapshot:
     # {"goodput_fraction", "dominant_badput", "elapsed_rank_seconds",
     #  "window_s", "buckets"}; None = no ledger attached
     goodput: Optional[Dict[str, Any]] = None
+    # the running plan's predicted-vs-measured entry
+    # (parallel/calibration.py PlanCalibration.current()):
+    # {"mesh", "predicted_step_s", "measured_step_s", "ratio",
+    #  "samples", ...}; None = no calibration attached / no plan yet
+    plan_calibration: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -341,9 +346,16 @@ class GoodputRule(Rule):
 
 
 class HbmPressureRule(Rule):
-    """Per-chip HBM used/total over the pressure threshold: the next
-    resize or batch bump will OOM — warn while there is still headroom
-    to act."""
+    """Per-chip HBM over the pressure threshold: the next resize or
+    batch bump will OOM — warn while there is still headroom to act.
+
+    Judges the PEAK WATERMARK when the chip stats carry one
+    (``hbm_peak_mb``, the allocator's in-step high-water mark from
+    obs/device.py): the 15 s monitor tick samples ``bytes_in_use``
+    BETWEEN steps — the trough — while the transient in-step peak is
+    what actually OOMs. The per-rank step-report watermark
+    (``hbm_peak_mb`` on the node entry) is folded in too; the trough
+    remains the fallback for senders predating the field."""
 
     name = "hbm_pressure"
 
@@ -356,12 +368,29 @@ class HbmPressureRule(Rule):
         pressured = set()
         for worker_id, stats in snapshot.node_stats.items():
             worst = 0.0
+            signal = "bytes_in_use"
+            max_total = 0.0
             for chip in stats.get("chips", ()):
                 total = float(chip.get("hbm_total_mb", 0.0) or 0.0)
                 if total <= 0:
                     continue
-                worst = max(worst, 100.0 * float(
-                    chip.get("hbm_used_mb", 0.0)) / total)
+                max_total = max(max_total, total)
+                peak = float(chip.get("hbm_peak_mb", -1.0) or -1.0)
+                if peak >= 0.0:
+                    used, chip_signal = peak, "peak_watermark"
+                else:
+                    used = float(chip.get("hbm_used_mb", 0.0))
+                    chip_signal = "bytes_in_use"
+                pct = 100.0 * used / total
+                if pct > worst:
+                    worst, signal = pct, chip_signal
+            # the step report's device-truth window peak (report-interval
+            # cadence — fresher than the chip-stats file relay)
+            node_peak = float(stats.get("hbm_peak_mb", -1.0) or -1.0)
+            if node_peak >= 0.0 and max_total > 0:
+                pct = 100.0 * node_peak / max_total
+                if pct > worst:
+                    worst, signal = pct, "step_peak_watermark"
             if worst >= ctx.diagnosis_hbm_pressure_pct:
                 pressured.add(worker_id)
                 if worker_id not in self._reported:
@@ -370,18 +399,100 @@ class HbmPressureRule(Rule):
                         rule=self.name, severity=WARNING,
                         worker_id=worker_id,
                         summary=(f"worker {worker_id} HBM pressure: "
-                                 f"{worst:.1f}% of a chip's HBM in use"),
-                        details={"worst_chip_pct": round(worst, 2)},
+                                 f"{worst:.1f}% of a chip's HBM "
+                                 f"({signal})"),
+                        details={"worst_chip_pct": round(worst, 2),
+                                 "signal": signal},
                         actions=[ACTION_ALERT],
                     ))
         self._reported &= pressured
         return reports
 
 
+class PlanRegressionRule(Rule):
+    """Measured step time exceeds the planner's prediction for the
+    RUNNING plan by ``plan_regression_ratio`` — the plan the fleet is
+    executing is slower than what it was chosen FOR, so the planner's
+    ranking (and every future resize decision scored with the same
+    prior) is suspect. Hysteresis like StragglerRule: the ratio must
+    hold for ``plan_regression_windows`` consecutive diagnosis rounds
+    (one slow window — a checkpoint, a GC pause — is noise), and fall
+    under for ``plan_regression_clear_windows`` to clear. A signature
+    change (a new plan applied) resets the evidence: the new shape is
+    judged on its own measurements. The calibration loop
+    (parallel/calibration.py) feeds the per-axis discounts back into
+    scoring either way; this rule is the ALERT that the loop had to
+    correct by more than the configured ratio."""
+
+    name = "plan_regression"
+
+    def __init__(self):
+        self._signature = ""
+        self._over = 0
+        self._under = 0
+        self._alerted = False
+
+    def evaluate(self, snapshot, ctx=None):
+        ctx = ctx or Context.singleton()
+        ratio_floor = ctx.plan_regression_ratio
+        entry = snapshot.plan_calibration
+        if ratio_floor <= 0.0 or not entry:
+            return []
+        if entry.get("signature", "") != self._signature:
+            self._signature = str(entry.get("signature", ""))
+            self._over = self._under = 0
+            self._alerted = False
+        predicted = float(entry.get("predicted_step_s", 0.0))
+        measured = float(entry.get("measured_step_s", 0.0))
+        samples = int(entry.get("samples", 0))
+        if predicted <= 0.0 or measured <= 0.0 \
+                or samples < ctx.calibration_min_samples:
+            return []
+        ratio = measured / predicted
+        if ratio > ratio_floor:
+            self._under = 0
+            self._over += 1
+            if not self._alerted \
+                    and self._over >= ctx.plan_regression_windows:
+                self._alerted = True
+                mesh = entry.get("mesh", {})
+                return [DiagnosisReport(
+                    rule=self.name, severity=WARNING,
+                    summary=(
+                        f"plan regression: measured {measured:.3f}s/"
+                        f"step is {ratio:.2f}x the planner's "
+                        f"{predicted:.3f}s prediction for mesh "
+                        f"{mesh} ({samples} windowed samples)"),
+                    details={"ratio": round(ratio, 3),
+                             "predicted_step_s": round(predicted, 6),
+                             "measured_step_s": round(measured, 6),
+                             "samples": samples,
+                             "mesh": dict(mesh),
+                             "windows_over": self._over},
+                    actions=[ACTION_ALERT],
+                )]
+            return []
+        self._over = 0
+        if self._alerted:
+            self._under += 1
+            if self._under >= ctx.plan_regression_clear_windows:
+                self._alerted = False
+                self._under = 0
+                return [DiagnosisReport(
+                    rule=self.name, severity=INFO,
+                    summary=(f"plan regression cleared: measured step "
+                             f"time back to {ratio:.2f}x prediction"),
+                    details={"ratio": round(ratio, 3)},
+                    actions=[ACTION_OBSERVE],
+                )]
+        return []
+
+
 def default_rules() -> List[Rule]:
     """The chain, cheapest-evidence first."""
     return [StragglerRule(), DataPipelineBoundRule(),
-            ThroughputCollapseRule(), HbmPressureRule(), GoodputRule()]
+            ThroughputCollapseRule(), HbmPressureRule(),
+            PlanRegressionRule(), GoodputRule()]
 
 
 def parse_action(action: str) -> Dict[str, Any]:

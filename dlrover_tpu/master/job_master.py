@@ -86,13 +86,28 @@ class JobMaster:
         # (obs/goodput.py); fed by the servicer, persisted with the
         # control-plane state, queried over RPC by tools/goodput.py
         self.goodput_ledger = obs.GoodputLedger()
+        # the fleet time-series plane (obs/tsdb.py): bounded multi-
+        # resolution history of the gauges/goodput/device truth, served
+        # over TimeSeriesQuery and rendered by tools/top.py; the
+        # collector (built with the state backend below — its sidecar
+        # lives in the state dir) samples + persists it
+        self.tsdb = obs.TimeSeriesStore()
+        self.tsdb_collector = None
+        # planner prediction <-> measurement calibration
+        # (parallel/calibration.py): stamped plans register their
+        # predicted step time, worker step reports register measured,
+        # learned per-axis discounts feed back into planner scoring
+        from dlrover_tpu.parallel.calibration import PlanCalibration
+
+        self.plan_calibration = PlanCalibration()
         self.diagnosis_manager = None
         if ctx.diagnosis_enabled:
             from dlrover_tpu.master.diagnosis import DiagnosisManager
 
             self.diagnosis_manager = DiagnosisManager(
                 self.speed_monitor,
-                goodput_ledger=self.goodput_ledger)
+                goodput_ledger=self.goodput_ledger,
+                plan_calibration=self.plan_calibration)
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             rdzv_managers=self.rdzv_managers,
@@ -103,7 +118,15 @@ class JobMaster:
             job_manager=job_manager,
             diagnosis_manager=self.diagnosis_manager,
             goodput_ledger=self.goodput_ledger,
+            tsdb=self.tsdb,
+            plan_calibration=self.plan_calibration,
         )
+        if self.diagnosis_manager is not None:
+            # learned-discount feedback rides the diagnosis cadence,
+            # not the per-report hot path (the medians only move as
+            # samples accumulate)
+            self.diagnosis_manager.discount_sink = \
+                self.servicer.push_axis_discounts
         self._host = host
         self._server, self.port = build_server(
             self.servicer.get_bytes, self.servicer.report_bytes,
@@ -246,6 +269,27 @@ class JobMaster:
             # first RPC is served
             self._maybe_snapshot()
         self.servicer.generation = self.generation
+        # the time-series collector: samples fleet vitals into the
+        # store and persists the downsampled tiers to a checksummed
+        # sidecar in the state dir (deliberately NOT the snapshot
+        # export — background samples must not churn save_if_changed
+        # versions). A restarted master or a promoted standby sharing
+        # the state dir reloads fleet history here.
+        self.tsdb_collector = obs.TsdbCollector(
+            self.tsdb, goodput_ledger=self.goodput_ledger,
+            state_dir=state_dir or "")
+        if state_dir:
+            # same fence as snapshots + the mutation log: a superseded
+            # primary's background flush must not clobber the promoted
+            # lineage's history sidecar
+            self.tsdb_collector.gate = self._check_fenced
+        restored_series = self.tsdb_collector.restore()
+        if restored_series:
+            logger.info("fleet time-series history restored: %d "
+                        "series", restored_series)
+            obs.get_flight_recorder().record_event(
+                "tsdb_restored", series=restored_series,
+                generation=self.generation)
 
     def _export_state(self) -> dict:
         state = {
@@ -256,6 +300,7 @@ class JobMaster:
             "kv_store": self.kv_store.export_state(),
             "speed_monitor": self.speed_monitor.export_state(),
             "goodput": self.goodput_ledger.export_state(),
+            "plan_calibration": self.plan_calibration.export_state(),
         }
         if self.diagnosis_manager is not None:
             state["diagnosis"] = self.diagnosis_manager.export_state()
@@ -275,6 +320,16 @@ class JobMaster:
         self.speed_monitor.restore_state(state.get("speed_monitor", {}))
         if "goodput" in state:
             self.goodput_ledger.restore_state(state["goodput"])
+        if "plan_calibration" in state:
+            self.plan_calibration.restore_state(
+                state["plan_calibration"])
+            # re-arm the planner with the restored evidence's learned
+            # discounts NOW — waiting for the first post-failover step
+            # report would score the re-formation plan with the bare
+            # prior the calibration already corrected
+            discounts = self.plan_calibration.axis_discounts()
+            if discounts:
+                self.servicer.push_axis_discounts(discounts)
         if self.diagnosis_manager is not None and "diagnosis" in state:
             self.diagnosis_manager.restore_state(state["diagnosis"])
         if self.job_manager is not None and "job_manager" in state and \
@@ -470,6 +525,8 @@ class JobMaster:
         self.task_manager.start_timeout_recovery()
         if self.diagnosis_manager is not None:
             self.diagnosis_manager.start()
+        if self.tsdb_collector is not None:
+            self.tsdb_collector.start()
         self._start_metrics_exporter()
         self._publish_bootstrap_addr()
         # an unhandled master crash still leaves the job timeline on disk
@@ -643,6 +700,20 @@ class JobMaster:
             # renders the ledger from the postmortem alone
             self.goodput_ledger.record_flight_snapshot(
                 reason="master-stop")
+            if self.tsdb_collector is not None:
+                # final history flush + a compact tsdb snapshot in the
+                # dump so `tools/top.py --flight` renders sparklines
+                # from the postmortem alone
+                self.tsdb_collector.stop()
+                try:
+                    obs.get_flight_recorder().record_event(
+                        "tsdb",
+                        snapshot=self.tsdb_collector.flight_snapshot(),
+                        calibration=self.plan_calibration.table(),
+                        axis_discounts=self.plan_calibration
+                        .axis_discounts())
+                except Exception:  # noqa: BLE001 — the dump must land
+                    logger.exception("tsdb flight snapshot failed")
             obs.get_flight_recorder().record_event(
                 "master_stop", exit_reason=self._exit_reason)
             obs.get_flight_recorder().dump(reason="master-stop")

@@ -185,6 +185,13 @@ class RendezvousManager:
         # serialize liveness-critical RPCs behind pure recomputation.
         self._last_plan: Optional[Dict] = None
         self._last_plan_inputs: Optional[Tuple] = None
+        # learned per-axis efficiency discounts from the calibration
+        # loop (parallel/calibration.py, pushed by the servicer):
+        # part of every plan's deterministic inputs. Deliberately NOT
+        # exported — the calibration itself persists and re-pushes
+        # after a restore, so the discounts can never outlive their
+        # evidence.
+        self._axis_discounts: Dict[str, float] = {}
         # rank -> chips, remembered across world invalidations: the
         # planner must see the EXPECTED post-re-formation world at the
         # FIRST survivor's join (cut worlds are emptied on a death and
@@ -510,6 +517,18 @@ class RendezvousManager:
                 self._chip_hbm_bytes = int(hbm_bytes)
                 self._mutations += 1
 
+    def set_axis_discounts(self, discounts: Dict[str, float]) -> None:
+        """Learned per-axis efficiency corrections from the calibration
+        loop (parallel/calibration.py, pushed by the servicer when the
+        learned table changes): scoring input for every later plan.
+        Changing them invalidates the plan memo (they are part of its
+        inputs) but deliberately does not bump the mutation counter —
+        derived state, re-pushed from the persisted calibration."""
+        with self._lock:
+            self._axis_discounts = {str(k): float(v)
+                                    for k, v in (discounts or {}).items()
+                                    if v and v > 0}
+
     def _plan_world_locked(self) -> Dict[int, int]:
         """(lock held) The world the next plan must cover: every alive,
         non-draining rank — cut worlds and the waiting list give the
@@ -571,9 +590,10 @@ class RendezvousManager:
             else:
                 generation = self._rdzv_round
                 round_ = self._rdzv_round
+            discounts = dict(self._axis_discounts)
             inputs = (tuple(sorted(world.items())), profile,
                       max(1, slices), generation, self._world_epoch,
-                      round_)
+                      round_, tuple(sorted(discounts.items())))
             if (self._last_plan is not None
                     and inputs == self._last_plan_inputs):
                 # identical inputs → identical (deterministic) plan:
@@ -583,7 +603,8 @@ class RendezvousManager:
             plan = planner.plan_parallelism(
                 world, profile, slices=max(1, slices),
                 prev_plan=self._last_plan, generation=generation,
-                epoch=self._world_epoch, round_=round_)
+                epoch=self._world_epoch, round_=round_,
+                axis_discounts=discounts or None)
             self._last_plan_inputs = inputs
             equivalent = planner.plans_equivalent(self._last_plan, plan)
             # a REAL re-plan needs a previous plan to differ from AND a

@@ -240,6 +240,7 @@ class ShardedRendezvousManager:
         self._chip_hbm_bytes = 0
         self._last_plan: Optional[Dict] = None
         self._last_plan_inputs: Optional[Tuple] = None
+        self._axis_discounts: Dict[str, float] = {}
 
     # -- routing ----------------------------------------------------------
     def _slice_params(self) -> RendezvousParameters:
@@ -518,6 +519,15 @@ class ShardedRendezvousManager:
                 self._chip_hbm_bytes = int(hbm_bytes)
                 self._mutations += 1
 
+    def set_axis_discounts(self, discounts: Dict[str, float]) -> None:
+        """Calibration-learned per-axis efficiency corrections (see the
+        single-lock manager's docstring): plan-scoring input, part of
+        the memo key, deliberately not a snapshot trigger."""
+        with self._lock:
+            self._axis_discounts = {str(k): float(v)
+                                    for k, v in (discounts or {}).items()
+                                    if v and v > 0}
+
     def _gather_plan_world(self) -> Dict[int, int]:
         """The world the next plan must cover (sharded analogue of the
         manager's ``_plan_world_locked``): per-shard cut worlds +
@@ -574,15 +584,18 @@ class ShardedRendezvousManager:
                 fsdp_divisor=int(self._model_profile.get(
                     "fsdp_divisor", 0)),
             )
+            discounts = dict(self._axis_discounts)
             inputs = (tuple(sorted(world.items())), profile,
-                      max(1, slices), generation, epoch, round_)
+                      max(1, slices), generation, epoch, round_,
+                      tuple(sorted(discounts.items())))
             if (self._last_plan is not None
                     and inputs == self._last_plan_inputs):
                 return dict(self._last_plan), False
             plan = planner.plan_parallelism(
                 world, profile, slices=max(1, slices),
                 prev_plan=self._last_plan, generation=generation,
-                epoch=epoch, round_=round_)
+                epoch=epoch, round_=round_,
+                axis_discounts=discounts or None)
             self._last_plan_inputs = inputs
             equivalent = planner.plans_equivalent(self._last_plan, plan)
             changed = (self._last_plan is not None and has_cut

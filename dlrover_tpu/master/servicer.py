@@ -63,6 +63,8 @@ class MasterServicer:
         metric_collector=None,
         diagnosis_manager=None,
         goodput_ledger=None,
+        tsdb=None,
+        plan_calibration=None,
     ):
         self.task_manager = task_manager or TaskManager()
         self.rdzv_managers: Dict[str, RendezvousManager] = rdzv_managers or {
@@ -81,6 +83,15 @@ class MasterServicer:
         # optional: the goodput ledger (obs/goodput.py) — fed from step
         # reports, telemetry spans and drain/failure handlers
         self.goodput_ledger = goodput_ledger
+        # optional: the fleet time-series store (obs/tsdb.py) — fed
+        # per-rank device truth from step reports here; job-level
+        # gauges ride the collector thread (JobMaster)
+        self.tsdb = tsdb
+        # optional: planner calibration (parallel/calibration.py) —
+        # stamped plans register predictions, step reports register
+        # measurements, learned discounts push back into the planner
+        self.plan_calibration = plan_calibration
+        self._pushed_discounts: Dict[str, float] = {}
         self._paral_config = msg.ParallelConfig()
         self._start_time = time.time()
         # crash-consistency hook (wired by JobMaster): called after any
@@ -196,6 +207,28 @@ class MasterServicer:
             return msg.GoodputReport(report_json=json.dumps(
                 self.goodput_ledger.snapshot(
                     window_s=request.window_s)))
+        if isinstance(request, msg.TimeSeriesQuery):
+            import json
+
+            if self.tsdb is None:
+                return msg.TimeSeriesResult(result_json="")
+            payload = self.tsdb.query_payload(
+                name=request.name,
+                labels=dict(request.labels) or None,
+                window_s=request.window_s,
+                resolution_s=request.resolution_s)
+            return msg.TimeSeriesResult(
+                result_json=json.dumps(payload))
+        if isinstance(request, msg.PlanCalibrationRequest):
+            import json
+
+            if self.plan_calibration is None:
+                return msg.PlanCalibrationReport(report_json="")
+            return msg.PlanCalibrationReport(report_json=json.dumps({
+                "table": self.plan_calibration.table(),
+                "discounts": self.plan_calibration.axis_discounts(),
+                "min_samples": self.plan_calibration.min_samples,
+            }))
         if isinstance(request, msg.SliceStatusRequest):
             import json
 
@@ -238,6 +271,7 @@ class MasterServicer:
             plan, changed = mgr.compute_shard_plan(request.node_rank)
             if changed:
                 self._note_replan(plan)
+            self._observe_plan(plan)
             if mgr.mutation_count != before:
                 self._sink_state()   # a new plan was stamped
             return msg.ShardPlanResult(
@@ -349,6 +383,7 @@ class MasterServicer:
                     shard_plan_json = json.dumps(shard_plan)
                     if changed:
                         self._note_replan(shard_plan)
+                    self._observe_plan(shard_plan)
                     if mgr.mutation_count != before:
                         self._sink_state()   # the stamped plan is state
                 except Exception:  # noqa: BLE001 — the planner must
@@ -405,6 +440,7 @@ class MasterServicer:
             degraded = int(getattr(request, "degraded_steps", 0) or 0)
             if degraded > 0:
                 self._observe_degraded_steps(rank, degraded)
+            self._observe_step_evidence(rank, request)
             self._touch_rendezvous(request.node_rank)
             # deliberately NOT a snapshot trigger (the per-step hot
             # path); the step high-water mark rides on the next
@@ -674,6 +710,62 @@ class MasterServicer:
                                checkpoint_ranks=checkpoint_ranks)
 
     # ------------------------------------------------------------------
+    def _observe_step_evidence(self, rank: int,
+                               request: msg.GlobalStepReport) -> None:
+        """Per-rank history + calibration feeds off one step report
+        (the hot path: appends only, no snapshot, no RPC fan-out).
+        The device-truth HBM watermark lands in the diagnosis node
+        stats (HbmPressureRule's preferred signal) and the time-series
+        store; timing evidence lands in the calibration table, whose
+        learned axis discounts push back into the planner whenever
+        they change."""
+        hbm_peak = float(getattr(request, "hbm_peak_bytes", 0.0) or 0.0)
+        peak_mb = hbm_peak / (1 << 20) if hbm_peak > 0 else -1.0
+        if peak_mb >= 0.0 and self.diagnosis_manager is not None:
+            self.diagnosis_manager.observe_step_watermark(rank, peak_mb)
+        if self.tsdb is not None:
+            node = {"node": str(rank)}
+            # dlrover_tpu_training_global_step is deliberately NOT
+            # ingested here: the collector samples the SpeedMonitor's
+            # fleet-truth gauge into that (unlabeled) series — a
+            # per-rank ingest on the same key would interleave
+            # straggler steps with the fleet step (one feed per series)
+            if request.step_time_s > 0:
+                self.tsdb.ingest(
+                    "dlrover_tpu_worker_step_time_seconds",
+                    request.step_time_s, node)
+            if request.mfu >= 0:
+                self.tsdb.ingest("dlrover_tpu_worker_mfu",
+                                 request.mfu, node)
+            if peak_mb >= 0.0:
+                self.tsdb.ingest("dlrover_tpu_worker_hbm_peak_mb",
+                                 peak_mb, node)
+        if self.plan_calibration is not None \
+                and request.step_time_s > 0:
+            self.plan_calibration.observe_step(
+                request.step_time_s, mfu=request.mfu,
+                plan_generation=int(getattr(
+                    request, "plan_generation", -1)))
+            # the learned-discount recompute + push deliberately does
+            # NOT happen here: this is the per-report hot path, and
+            # the medians only move as samples accumulate — the
+            # diagnosis loop's cadence recomputes and pushes
+            # (DiagnosisManager.discount_sink)
+
+    def push_axis_discounts(self, discounts: Dict[str, float]) -> None:
+        """Feed learned calibration discounts into planner scoring,
+        deduped on change. The single owner of the push state — the
+        restore path (JobMaster) reuses it so the dedup field never
+        has a second writer."""
+        if discounts == self._pushed_discounts:
+            return
+        self._pushed_discounts = discounts
+        training = self.rdzv_managers.get(RendezvousName.TRAINING)
+        if training is not None and \
+                hasattr(training, "set_axis_discounts"):
+            training.set_axis_discounts(discounts)
+
+    # ------------------------------------------------------------------
     def _note_replan(self, plan: Dict) -> None:
         """A REAL re-plan was stamped (the execution shape changed):
         attribute the next world re-formation to it in the goodput
@@ -691,6 +783,18 @@ class MasterServicer:
             "dlrover_tpu_replans_total",
             "Parallelism re-plans stamped (the execution shape "
             "changed at a resize)").inc()
+
+    # ------------------------------------------------------------------
+    def _observe_plan(self, plan: Dict) -> None:
+        """Register a stamped plan's prediction with the calibration
+        table (idempotent per signature; re-stamps for late joiners
+        continue the same measurement series)."""
+        if self.plan_calibration is None:
+            return
+        try:
+            self.plan_calibration.observe_plan(plan)
+        except Exception:  # noqa: BLE001 — calibration is advisory
+            logger.exception("plan calibration observe failed")
 
     # ------------------------------------------------------------------
     def _push_slice_map(self, mgr) -> None:

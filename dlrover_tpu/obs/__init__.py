@@ -21,7 +21,8 @@ from __future__ import annotations
 
 import threading
 
-from dlrover_tpu.obs import mfu
+from dlrover_tpu.obs import device, mfu
+from dlrover_tpu.obs.device import DeviceTelemetry
 from dlrover_tpu.obs.flight_recorder import (
     FLIGHT_DIR_ENV,
     FlightRecorder,
@@ -57,12 +58,18 @@ from dlrover_tpu.obs.spans import (
     span,
 )
 from dlrover_tpu.obs.timeline import StepTimeline, load_timeline
+from dlrover_tpu.obs.tsdb import (
+    TimeSeriesSidecar,
+    TimeSeriesStore,
+    TsdbCollector,
+)
 
 __all__ = [
     "BADPUT_BUCKETS",
     "BUCKETS",
     "DEFAULT_BUCKETS",
     "FLIGHT_DIR_ENV",
+    "DeviceTelemetry",
     "FlightRecorder",
     "GoodputLedger",
     "MetricsRegistry",
@@ -71,6 +78,10 @@ __all__ = [
     "Span",
     "SpanExporter",
     "StepTimeline",
+    "TimeSeriesSidecar",
+    "TimeSeriesStore",
+    "TsdbCollector",
+    "device",
     "add_span_sink",
     "current_context",
     "current_span",
@@ -153,7 +164,13 @@ def publish_node_stats(stats, registry: MetricsRegistry = None) -> None:
     ResourceMonitor for its local registry and by the master servicer
     when the report arrives, so the two expositions cannot drift."""
     registry = registry or get_registry()
-    labels = {"node": str(stats.node_id),
+    # keyed by RANK when the sender provides one: node_id diverges from
+    # rank after a relaunch, and every other per-worker series (the
+    # servicer's step-report ingest, the diagnosis gauges) is
+    # rank-keyed — a node_id key here would split one physical worker
+    # into two dashboard rows the moment it relaunches
+    rank = getattr(stats, "node_rank", -1)
+    labels = {"node": str(rank if rank >= 0 else stats.node_id),
               "type": stats.node_type or "worker"}
     registry.gauge("dlrover_tpu_node_cpu_percent",
                    "Host CPU utilization reported by the agent",
@@ -164,11 +181,34 @@ def publish_node_stats(stats, registry: MetricsRegistry = None) -> None:
                    labelnames=("node", "type")).labels(
         **labels).set(stats.memory_mb)
     if stats.chip_stats:
-        hbm = sum(c.hbm_used_mb for c in stats.chip_stats)
-        registry.gauge("dlrover_tpu_node_hbm_used_mb",
-                       "Sum of per-chip HBM in use",
-                       labelnames=("node", "type")).labels(
-            **labels).set(hbm)
+        # HBM series only when the backend actually reported memory
+        # stats (any chip with a real total): a CPU backend's absent
+        # memory_stats must not publish a forever-0 % series that
+        # dashboards read as "plenty of headroom"
+        if any(c.hbm_total_mb > 0 for c in stats.chip_stats):
+            hbm = sum(c.hbm_used_mb for c in stats.chip_stats)
+            registry.gauge("dlrover_tpu_node_hbm_used_mb",
+                           "Sum of per-chip HBM in use",
+                           labelnames=("node", "type")).labels(
+                **labels).set(hbm)
+            # the per-step peak watermark (obs/device.py via the chip
+            # stats export): the transient IN-step peak, < 0 = unknown.
+            # The export windows the lifetime-monotone counter (only a
+            # RISE carries hbm_peak_mb), so a report without one means
+            # the episode resolved — the gauge must follow the worst
+            # current in-use instead of latching the old spike forever
+            # (the series the time-series collector samples every tick)
+            peaks = [c.hbm_peak_mb for c in stats.chip_stats
+                     if getattr(c, "hbm_peak_mb", -1.0) >= 0.0]
+            registry.gauge(
+                "dlrover_tpu_node_hbm_peak_mb",
+                "Worst per-chip HBM allocator peak watermark "
+                "(in-step transient when it rose this window, else "
+                "the worst current in-use)",
+                labelnames=("node", "type")).labels(
+                **labels).set(max(peaks) if peaks else
+                              max(c.hbm_used_mb
+                                  for c in stats.chip_stats))
         # duty < 0 is the "unknown" sentinel (agent/monitor.py
         # export_chip_stats only emits a value when it can derive the
         # proxy): averaging it in would fabricate utilization

@@ -272,6 +272,34 @@ class MetricsRegistry:
                               key=lambda f: f.name)
         return "\n".join(f.render() for f in families) + "\n"
 
+    def sample_values(self, prefixes: Sequence[str] = ()
+                      ) -> list:
+        """Point-in-time (name, labels-dict, value) tuples for every
+        gauge/counter child whose family name starts with one of
+        ``prefixes`` (all scalar families when empty) — the time-series
+        collector's read path (obs/tsdb.py). Histograms are excluded:
+        their cumulative buckets are not a samplable scalar. Child
+        ``get()`` runs OUTSIDE the family lock (collect-time gauge
+        callbacks may themselves take component locks)."""
+        wanted = tuple(prefixes)
+        with self._lock:
+            families = [f for f in self._families.values()
+                        if f.kind in ("gauge", "counter")
+                        and (not wanted or f.name.startswith(wanted))]
+        out = []
+        for family in families:
+            with family._lock:
+                children = list(family._children.items())
+            for key, child in children:
+                try:
+                    value = child.get()
+                except Exception:  # noqa: BLE001 — one bad callback
+                    # must not break the whole sampling tick
+                    continue
+                out.append((family.name,
+                            dict(zip(family.labelnames, key)), value))
+        return out
+
     def reset(self) -> None:
         """Tests only: drop every family."""
         with self._lock:

@@ -196,16 +196,35 @@ def choose_accum(global_batch: int, dp: int,
     return accum, global_batch // accum
 
 
-def _efficiency(candidate: MeshCandidate, accum: int) -> float:
+def _efficiency(candidate: MeshCandidate, accum: int,
+                axis_discounts: Optional[Dict[str, float]] = None
+                ) -> float:
     """Predicted fraction of aggregate peak the candidate sustains.
     The pipeline term is the classic bubble fraction with ``accum``
-    microbatches: m / (m + p - 1)."""
+    microbatches: m / (m + p - 1).
+
+    ``axis_discounts`` are LEARNED multiplicative corrections from the
+    calibration loop (parallel/calibration.py: measured step time vs
+    this very prediction, per axis, normalized against shapes not
+    using the axis): a discount < 1 on an axis the fleet measured
+    slower than the prior predicts shifts scoring away from it. Only
+    active axes (> 1 way) are discounted, so plain data parallelism
+    stays the un-discounted baseline the corrections are relative to."""
     eff = _BASE_EFFICIENCY
     eff *= 1.0 / (1.0 + _TENSOR_PENALTY * (candidate.tensor - 1))
     eff *= 1.0 / (1.0 + _FSDP_PENALTY * (candidate.fsdp - 1))
     eff *= 1.0 / (1.0 + _DCN_PENALTY * (candidate.dcn - 1))
     if candidate.pipe > 1:
         eff *= accum / (accum + candidate.pipe - 1.0)
+    if axis_discounts:
+        for axis, ways in (("dcn", candidate.dcn),
+                           ("data", candidate.data),
+                           ("fsdp", candidate.fsdp),
+                           ("tensor", candidate.tensor),
+                           ("pipe", candidate.pipe)):
+            discount = axis_discounts.get(axis)
+            if ways > 1 and discount and discount > 0:
+                eff *= float(discount)
     return eff
 
 
@@ -237,7 +256,9 @@ def migration_bytes(candidate: MeshCandidate,
 
 def score_candidate(candidate: MeshCandidate, profile: ModelProfile,
                     prev_mesh: Optional[Dict[str, int]] = None,
-                    prev_world: int = 0) -> Optional[Dict[str, Any]]:
+                    prev_world: int = 0,
+                    axis_discounts: Optional[Dict[str, float]] = None
+                    ) -> Optional[Dict[str, Any]]:
     """Score one candidate; None when it is infeasible (batch smaller
     than dp, or the state cannot fit the HBM budget)."""
     requested = profile.global_batch
@@ -268,7 +289,7 @@ def score_candidate(candidate: MeshCandidate, profile: ModelProfile,
     # predicted step time from the MFU model: tokens × FLOPs/token over
     # the discounted aggregate peak. Unknown model/peak → 0 (candidates
     # then rank purely on migration + batch terms + tie-break).
-    eff = _efficiency(candidate, accum)
+    eff = _efficiency(candidate, accum, axis_discounts)
     step_s = 0.0
     if (profile.flops_per_token > 0 and profile.peak_flops_per_chip > 0
             and profile.seq_len > 0 and batch > 0):
@@ -316,7 +337,9 @@ def plan_parallelism(world: Dict[int, int],
                      epoch: int = 0,
                      round_: int = 0,
                      max_tensor: int = 8,
-                     max_pipe: int = 8) -> Dict[str, Any]:
+                     max_pipe: int = 8,
+                     axis_discounts: Optional[Dict[str, float]] = None
+                     ) -> Dict[str, Any]:
     """THE planner entry: (new world, model profile, previous plan) →
     one deterministic JSON-safe plan.
 
@@ -325,6 +348,10 @@ def plan_parallelism(world: Dict[int, int],
     ``prev_plan``: the previously stamped plan (its mesh feeds the
     migration term so a resize that can keep the sharding is preferred
     over an equivalent-speed one that re-shards everything).
+    ``axis_discounts``: learned per-axis efficiency corrections from
+    the calibration loop — part of the plan's deterministic inputs
+    (callers memoize on them too) and stamped into the plan so the
+    flight record shows WHICH prior scored it.
 
     Always returns a plan: when no candidate is feasible (a memory
     budget nothing fits, or an empty world) the least-infeasible
@@ -364,7 +391,8 @@ def plan_parallelism(world: Dict[int, int],
                                           max_pipe=pass_caps[1]):
             scored = score_candidate(candidate, profile,
                                      prev_mesh=prev_mesh,
-                                     prev_world=prev_world)
+                                     prev_world=prev_world,
+                                     axis_discounts=axis_discounts)
             if scored is None:
                 continue
             # deterministic total order: score, then prefer the SAFE
@@ -399,6 +427,11 @@ def plan_parallelism(world: Dict[int, int],
     plan = dict(base, **best)
     plan["migration_s_estimate"] = round(
         best["migration_bytes"] / _MIGRATION_BYTES_PER_S, 3)
+    if axis_discounts:
+        # the calibrated prior this plan was scored with — the flight
+        # record of "the loop was closed" (parallel/calibration.py)
+        plan["axis_discounts"] = {k: float(v) for k, v
+                                  in sorted(axis_discounts.items())}
     # did the sharding change vs the previous plan? (what the worker's
     # replan event and the goodput summary report)
     plan["resharded"] = bool(

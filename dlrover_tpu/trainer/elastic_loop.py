@@ -150,6 +150,14 @@ class ElasticTrainLoop:
         self._replan_changed = False
         self.global_batch = config.global_batch
         self._trim_batch = 0
+        # device-truth HBM peak watermark (obs/device.py): one
+        # memory_stats read per local device per step, CPU-safe no-op
+        # after one probe; the report-window peak rides the step report
+        # so HbmPressureRule judges the IN-step transient, not the
+        # between-steps trough the monitor tick samples. Built BEFORE
+        # the trainer so every (re)build can mark a program-episode
+        # boundary (note_recompile).
+        self.device_telemetry = obs.DeviceTelemetry()
         if trainer is not None:
             self.trainer = trainer
             self.mesh = trainer.mesh
@@ -313,6 +321,10 @@ class ElasticTrainLoop:
         # decomposition (plan → migrate → rebuild) the goodput tools
         # price per resize. The nested relower `recompile` span
         # stays the ledger's compile evidence (no double count).
+        # a new program is about to be built: the old one's recurring
+        # in-step peak stops being HBM-pressure evidence unless the new
+        # program re-reaches it (obs/device.py episode semantics)
+        self.device_telemetry.note_recompile()
         rebuild_cm = (
             obs.span("replan_rebuild",
                      {"generation": self._shard_plan.get(
@@ -672,6 +684,19 @@ class ElasticTrainLoop:
         if compiled is None:
             return
         self._flops_cross_checked = True
+        # one compile event per AOT build: wall time + the compiled
+        # step's cost-analysis FLOPs/bytes into the flight record and
+        # gauges (obs/device.py) — the device truth behind the MFU
+        # cross-check below and the calibration table's predictions
+        try:
+            timings = getattr(self.trainer, "precompile_timings", {})
+            obs.device.record_compile_event(
+                wall_s=float(timings.get("trace_lower_s", 0.0))
+                + float(timings.get("compile_or_cache_load_s", 0.0)),
+                compiled=compiled, kind="aot",
+                mesh=dict(self.mesh.shape))
+        except Exception:  # noqa: BLE001 — telemetry, never the loop
+            logger.warning("compile event record failed", exc_info=True)
         measured = obs.mfu.cost_analysis_flops(compiled)
         tokens_per_step = self.global_batch * self.config.seq_len
         adopted = obs.mfu.cross_check(self._flops_per_token, measured,
@@ -992,6 +1017,7 @@ class ElasticTrainLoop:
                 ckpt_s = _time.monotonic() - t_compute_end
             if self._watchdog is not None:
                 self._watchdog.notify_step(step)
+            self.device_telemetry.on_step(step)
             self.timeline.record(
                 step, _time.monotonic() - t_step,
                 data_wait=t_data - t_step,
@@ -1204,11 +1230,25 @@ class ElasticTrainLoop:
             self._flops_per_token, self._peak_flops_total)
         degraded = (self._slice_sync.drain_unreported()
                     if self._slice_sync is not None else 0)
+        # device-truth HBM window peak (0 = backend has no memory
+        # stats): drained per report so the master sees each window's
+        # watermark, not a stale lifetime number
+        hbm = self.device_telemetry.drain()
+        # calibration attributes this window's timing by the plan the
+        # loop ACTUALLY applied: -2 (fallback / no plan / batch-only
+        # replica mode, which runs a full local replica rather than
+        # the stamped mesh) is dropped by the master rather than
+        # contaminating the stamped shape
+        plan_gen = (int(self._shard_plan.get("generation", 0) or 0)
+                    if self._shard_plan is not None
+                    and self._replan_applied == "mesh+batch" else -2)
         try:
             self.client.report_global_step(
                 step, step_time_s=mean_step,
                 data_wait_fraction=stats.get("data_wait_fraction", -1.0),
-                mfu=mfu, degraded_steps=degraded)
+                mfu=mfu, degraded_steps=degraded,
+                hbm_peak_bytes=hbm.get("hbm_peak_bytes", 0.0),
+                plan_generation=plan_gen)
         except Exception:  # noqa: BLE001 — droppable by contract
             # the degraded tally must not vanish with a dropped report
             if degraded and self._slice_sync is not None:

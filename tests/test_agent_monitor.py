@@ -67,6 +67,39 @@ class TestTrainingMonitor:
         monitor.stop()
         assert master.speed_monitor.completed_global_step == 7
 
+    def test_relays_plan_generation(self, tmp_path):
+        """report_step's optional plan_generation must ride the relay so
+        a file-reporting trainer's timing lands on the mesh shape it
+        actually ran; senders that don't track plans stay legacy (-1,
+        current-signature attribution)."""
+        metrics = str(tmp_path / "metrics.jsonl")
+        seen = {}
+
+        class _Client:
+            def report_global_step(self, step, **kw):
+                seen["step"] = step
+                seen.update(kw)
+                return True
+
+        monitor = TrainingMonitor(_Client(), metrics, interval_s=0.01)
+        monitor.start()
+        try:
+            report_step(5, metrics, step_time_s=0.1, plan_generation=7)
+            deadline = time.time() + 5
+            while seen.get("step") != 5 and time.time() < deadline:
+                time.sleep(0.02)
+            assert seen["step"] == 5
+            assert seen["plan_generation"] == 7
+            assert seen["step_time_s"] == pytest.approx(0.1)
+            report_step(6, metrics, step_time_s=0.1)
+            deadline = time.time() + 5
+            while seen.get("step") != 6 and time.time() < deadline:
+                time.sleep(0.02)
+            assert seen["step"] == 6
+            assert seen["plan_generation"] == -1
+        finally:
+            monitor.stop()
+
 
 class TestHangingDetector:
     def test_detects_stale_progress(self, tmp_path):

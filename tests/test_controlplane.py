@@ -720,6 +720,22 @@ class TestStandbyPromotion:
             c0.join_rendezvous(local_world_size=4)
             c1.join_rendezvous(local_world_size=4)
             assert c0.get_comm_world()[2] == {0: 4, 1: 4}
+            # fleet history + calibration before the kill: step reports
+            # feed the tsdb (device-truth watermark) and the planner
+            # calibration; a later cold mutation snapshots the
+            # calibration, the collector flush persists the tsdb
+            # sidecar — BOTH must survive the promotion
+            c0.report_model_info(
+                param_count=1000, param_bytes=4000,
+                flops_per_token=6000.0, peak_flops_per_chip=1e12,
+                batch_size=8, seq_len=32)
+            for i in range(3):
+                c0.report_global_step(
+                    5 + i, step_time_s=0.05, mfu=0.4,
+                    hbm_peak_bytes=256.0 * (1 << 20))
+            primary.tsdb_collector.sample_once()
+            assert primary.tsdb_collector.flush()
+            assert primary.plan_calibration.current()["samples"] == 3
             c0.kv_set("coordinator", b"10.0.0.1:1")   # cold
             # a hot coord/ barrier set AFTER the last cold snapshot:
             # must survive promotion via the mutation-log tail
@@ -749,6 +765,20 @@ class TestStandbyPromotion:
                 b"10.0.0.1:1"
             assert promoted.kv_store.get(
                 "coord/elastic-training/0") == b"hot-tail"
+            # fleet history survived: the promoted master's time-series
+            # store answers the dead primary's device-truth watermark
+            # series from the sidecar, and the planner calibration
+            # (predicted vs measured, through the snapshot) kept its
+            # measurement evidence
+            history = promoted.tsdb.query(
+                "dlrover_tpu_worker_hbm_peak_mb",
+                labels={"node": "0"}, resolution_s=10.0)
+            assert history and history[0]["points"], \
+                "promoted master lost the tsdb history"
+            assert history[0]["points"][-1][1] == 256.0
+            entry = promoted.plan_calibration.current()
+            assert entry is not None and entry["samples"] == 3
+            assert entry["measured_step_s"] == 0.05
             # bootstrap handoff carries the new generation
             with open(str(tmp_path / "master.addr")) as f:
                 bootstrap = json.load(f)

@@ -460,6 +460,33 @@ class TestDiagnosisManager:
         manager.evict_workers({0})
         assert manager.poll_actions(1) == []
 
+    def test_step_watermark_expires_by_its_own_age(self, diag_ctx):
+        from dlrover_tpu.common import messages as msg
+
+        manager = DiagnosisManager(SpeedMonitor())
+        manager.observe_step_watermark(0, 900.0)
+        stats = msg.NodeResourceStats(node_id=0, node_rank=0,
+                                      cpu_percent=10.0)
+        # a fresh chip relay preserves the step-report watermark...
+        manager.observe_resource_stats(stats)
+        assert manager._node_stats[0]["hbm_peak_mb"] == 900.0
+        # ...but a wedged loop (no new step reports while the relay
+        # keeps refreshing the entry) must not latch it forever: the
+        # watermark expires by ITS age, not the relay's
+        manager._node_stats[0]["hbm_peak_ts"] -= 1000.0
+        manager.observe_resource_stats(stats)
+        assert "hbm_peak_mb" not in manager._node_stats[0]
+
+    def test_discount_push_rides_the_diagnosis_cadence(self, diag_ctx):
+        from dlrover_tpu.parallel.calibration import PlanCalibration
+
+        cal = PlanCalibration(min_samples=1)
+        manager = DiagnosisManager(SpeedMonitor(), plan_calibration=cal)
+        pushed = []
+        manager.discount_sink = pushed.append
+        manager.diagnose_once()
+        assert pushed == [{}]     # no evidence yet: prior stands
+
     def test_resource_stats_keyed_by_rank(self, diag_ctx):
         from dlrover_tpu.common import messages as msg
 

@@ -1,0 +1,502 @@
+#!/usr/bin/env python3
+"""Live fleet dashboard: the master's time-series plane at a glance.
+
+Two sources, one screen (obs/tsdb.py over the TimeSeriesQuery RPC, or
+the ``tsdb`` snapshot event a master leaves in its flight dump):
+
+    # live: ANSI-refresh against a running master
+    python tools/top.py --master 10.0.0.2:50051 [--interval 2]
+
+    # one deterministic frame (golden tests, scripts, narrow pipes)
+    python tools/top.py --master 10.0.0.2:50051 --once
+
+    # postmortem: the same dashboard from a flight dump
+    python tools/top.py --flight flight-master-7.json --once
+
+Sections: job vitals with sparklines (steps/s, MFU, goodput fraction),
+per-slice step-time/MFU/goodput rollups, per-rank HBM watermark bars
+(device-truth in-step peaks, obs/device.py), the planner calibration
+table (predicted vs measured step time per mesh — parallel/
+calibration.py), control-plane health (slices formed / generations),
+recent diagnosis reports and the resize/promotion history priced by the
+goodput ledger.
+
+Exit codes: 0 ok; 2 on unreadable inputs / unreachable master.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+_BAR_WIDTH = 24
+_SPARK_WIDTH = 32
+
+
+def sparkline(values: List[float], width: int = _SPARK_WIDTH) -> str:
+    """Unicode block sparkline of the LAST ``width`` values, scaled to
+    the rendered window's own min/max (a flat series renders mid-row,
+    never invisibly at the floor)."""
+    values = [float(v) for v in values][-width:]
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi - lo < 1e-12:
+        return _SPARK_BLOCKS[3] * len(values)
+    span = hi - lo
+    out = []
+    for v in values:
+        idx = int((v - lo) / span * (len(_SPARK_BLOCKS) - 1))
+        out.append(_SPARK_BLOCKS[max(0, min(idx,
+                                            len(_SPARK_BLOCKS) - 1))])
+    return "".join(out)
+
+
+def hbar(fraction: float, width: int = _BAR_WIDTH) -> str:
+    """A [####....] utilization bar, clamped."""
+    fraction = max(0.0, min(1.0, float(fraction)))
+    filled = int(round(fraction * width))
+    return "[" + "#" * filled + "." * (width - filled) + "]"
+
+
+def _point_value(point, field: str = "mean") -> float:
+    """One point's value: raw points are [ts, v]; tier points are
+    [ts, mean, min, max, count, last] — ``field="last"`` reads the
+    bucket's newest value (the honest "current" number; a ramping open
+    bucket's mean is history)."""
+    if field == "last" and len(point) >= 6:
+        return float(point[5])
+    return float(point[1])
+
+
+def _series_values(series: List[Dict[str, Any]], name: str,
+                   labels: Optional[Dict[str, str]] = None,
+                   field: str = "mean") -> List[float]:
+    """Point values of the first series matching name + label subset."""
+    want = labels or {}
+    for record in series:
+        if record.get("name") != name:
+            continue
+        have = record.get("labels") or {}
+        if any(have.get(k) != v for k, v in want.items()):
+            continue
+        return [_point_value(p, field)
+                for p in record.get("points", []) if len(p) >= 2]
+    return []
+
+
+def _series_label_values(series: List[Dict[str, Any]], name: str,
+                         label: str) -> Dict[str, List[float]]:
+    """label value -> point values, for every series of ``name``
+    labeled by ``label`` (e.g. per-slice, per-node fan-outs)."""
+    out: Dict[str, List[float]] = {}
+    for record in series:
+        if record.get("name") != name:
+            continue
+        key = (record.get("labels") or {}).get(label)
+        if key is None:
+            continue
+        out[str(key)] = [float(p[1]) for p in record.get("points", [])
+                         if len(p) >= 2]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# data collection
+# ---------------------------------------------------------------------------
+
+# single-sourced with the master's flight-dump snapshot (obs/tsdb.py):
+# the --flight render must never silently miss a column the live
+# dashboard shows
+from dlrover_tpu.obs.tsdb import DASHBOARD_SERIES as _DASH_SERIES  # noqa: E402
+
+
+def collect_from_master(client, window_s: float = 900.0
+                        ) -> Dict[str, Any]:
+    """One dashboard frame's data from a live master."""
+    series: List[Dict[str, Any]] = []
+    tiers: List[Dict[str, Any]] = []
+    stats: Dict[str, Any] = {}
+    for name in _DASH_SERIES:
+        payload = client.query_timeseries(name, window_s=window_s)
+        series.extend(payload.get("series", []))
+        tiers = payload.get("tiers", tiers)
+        stats = payload.get("stats", stats)
+    try:
+        goodput = client.get_goodput()
+    except Exception:  # noqa: BLE001 — partial frames render fine
+        goodput = {}
+    try:
+        slices = client.get_slice_status()
+    except Exception:  # noqa: BLE001
+        slices = {}
+    try:
+        diagnosis = client.get_diagnosis_reports(limit=8)
+    except Exception:  # noqa: BLE001
+        diagnosis = []
+    try:
+        calibration = client.get_plan_calibration()
+    except Exception:  # noqa: BLE001
+        calibration = {}
+    return {
+        "source": f"master {client.master_addr}",
+        "series": series,
+        "tiers": tiers,
+        "tsdb_stats": stats,
+        "goodput": goodput,
+        "slices": slices,
+        "diagnosis": diagnosis,
+        "calibration": calibration,
+        "history": [],
+    }
+
+
+def collect_from_flight(payload: Dict[str, Any],
+                        path: str = "") -> Dict[str, Any]:
+    """The same frame's data reconstructed from a master flight dump:
+    the ``tsdb`` snapshot event carries the series + calibration, the
+    ``goodput`` event the ledger, ``diagnosis`` events the reports and
+    the lifecycle events the resize/promotion history."""
+    from dlrover_tpu.obs.goodput import snapshot_from_flight
+
+    series: List[Dict[str, Any]] = []
+    stats: Dict[str, Any] = {}
+    calibration: Dict[str, Any] = {}
+    diagnosis: List[Dict[str, Any]] = []
+    history: List[Dict[str, Any]] = []
+    for record in payload.get("events", []):
+        if record.get("kind") != "event":
+            continue
+        name = record.get("name")
+        attrs = record.get("attrs", {})
+        if name == "tsdb":
+            snap = attrs.get("snapshot") or {}
+            series = snap.get("series", [])
+            stats = snap.get("stats", {})
+            calibration = {
+                "table": attrs.get("calibration") or [],
+                # same shape as get_plan_calibration(): the --flight
+                # render must show the learned-discounts line the live
+                # screen does ({} on dumps predating the field)
+                "discounts": attrs.get("axis_discounts") or {},
+            }
+        elif name == "diagnosis":
+            diagnosis.append({
+                "rule": attrs.get("rule", "?"),
+                "severity": attrs.get("severity", "?"),
+                "worker_id": attrs.get("worker", -1),
+                "summary": attrs.get("summary", ""),
+                "ts": record.get("ts", 0.0),
+            })
+        elif name in ("replan_stamped", "replan_applied",
+                      "master_promoted", "master_restore",
+                      "slice_world_cut", "node_draining"):
+            history.append({"name": name, "ts": record.get("ts", 0.0),
+                            "attrs": attrs})
+    return {
+        "source": f"flight {path}" if path else "flight dump",
+        "series": series,
+        "tiers": [],
+        "tsdb_stats": stats,
+        "goodput": snapshot_from_flight(payload) or {},
+        "slices": {},
+        "diagnosis": diagnosis[-8:],
+        "calibration": calibration,
+        "history": history,
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering (pure: dict in, text out — the golden-testable surface)
+# ---------------------------------------------------------------------------
+
+
+def _mesh_compact(mesh: Dict[str, Any]) -> str:
+    return "x".join(str(mesh.get(k, 1))
+                    for k in ("dcn", "data", "fsdp", "tensor", "pipe"))
+
+
+def render_vitals(data: Dict[str, Any]) -> List[str]:
+    series = data["series"]
+    steps = _series_values(series,
+                           "dlrover_tpu_training_steps_per_second")
+    mfu = _series_values(series, "dlrover_tpu_training_mfu")
+    good = _series_values(series, "dlrover_tpu_goodput_fraction")
+    step = _series_values(series, "dlrover_tpu_training_global_step",
+                          field="last")
+    goodput = data.get("goodput") or {}
+    lines = ["== fleet vitals"]
+    current_step = int(step[-1]) if step else 0
+    workers = len((goodput.get("per_rank") or {}))
+    lines.append(
+        f"step {current_step:>10}   workers {workers:>3}   "
+        f"goodput {100.0 * float(goodput.get('goodput_fraction', 0.0)):5.1f}%"
+        f"   ({data.get('source', '?')})")
+    for label, values, fmt in (
+            ("steps/s", steps, "{:8.3f}"),
+            ("mfu", [v for v in mfu if v >= 0.0], "{:8.3f}"),
+            ("goodput", good, "{:8.3f}")):
+        if not values:
+            lines.append(f"  {label:<9} (no history)")
+            continue
+        lines.append("  {:<9} {} {}".format(
+            label, fmt.format(values[-1]), sparkline(values)))
+    return lines
+
+
+def render_slices_section(data: Dict[str, Any]) -> List[str]:
+    series = data["series"]
+    per_slice_steps = _series_label_values(
+        series, "dlrover_tpu_slice_steps_per_second", "slice")
+    per_slice_mfu = _series_label_values(
+        series, "dlrover_tpu_slice_mfu", "slice")
+    per_slice_workers = _series_label_values(
+        series, "dlrover_tpu_slice_workers", "slice")
+    status = ((data.get("slices") or {}).get("slices") or {})
+    slice_ids = sorted(set(per_slice_steps) | set(per_slice_mfu)
+                       | set(status), key=str)
+    lines = [f"== slices ({len(slice_ids)})"]
+    if not slice_ids:
+        lines.append("  (single-slice job / no per-slice history)")
+        return lines
+    lines.append("  {:<7} {:>8} {:>7} {:>8} {:<10} {}".format(
+        "slice", "steps/s", "mfu", "workers", "state", "trend"))
+    for sid in slice_ids:
+        steps = per_slice_steps.get(sid, [])
+        mfu = per_slice_mfu.get(sid, [])
+        workers = per_slice_workers.get(sid, [])
+        info = status.get(str(sid), status.get(sid, {}))
+        state = "formed" if info.get("formed") else (
+            "draining" if info.get("draining") else
+            ("?" if not info else "re-forming"))
+        gen = info.get("generation")
+        if gen is not None:
+            state += f" g{gen}"
+        lines.append("  {:<7} {:>8} {:>7} {:>8} {:<10} {}".format(
+            sid,
+            f"{steps[-1]:.3f}" if steps else "-",
+            f"{mfu[-1]:.3f}" if mfu else "-",
+            f"{int(workers[-1])}" if workers else "-",
+            state, sparkline(steps, 16)))
+    return lines
+
+
+def render_hbm(data: Dict[str, Any]) -> List[str]:
+    series = data["series"]
+    peaks = _series_label_values(series,
+                                 "dlrover_tpu_worker_hbm_peak_mb",
+                                 "node")
+    used = _series_label_values(series, "dlrover_tpu_node_hbm_used_mb",
+                                "node")
+    nodes = sorted(set(peaks) | set(used),
+                   key=lambda n: (len(n), n))
+    lines = ["== hbm watermarks (device-truth in-step peaks)"]
+    if not nodes:
+        lines.append("  (no hbm telemetry: CPU backend or no reports)")
+        return lines
+    all_values = [v for vals in list(peaks.values())
+                  + list(used.values()) for v in vals]
+    ceiling = max(all_values) if all_values else 1.0
+    for node in nodes:
+        peak_vals = peaks.get(node, [])
+        peak = peak_vals[-1] if peak_vals else 0.0
+        trough_vals = used.get(node, [])
+        trough = trough_vals[-1] if trough_vals else 0.0
+        level = peak if peak > 0 else trough
+        lines.append(
+            "  node {:<5} {} peak {:>12}  trough {:>12} {}".format(
+                node, hbar(level / ceiling if ceiling else 0.0),
+                f"{peak:.1f}MiB" if peak_vals else "-",
+                f"{trough:.1f}MiB" if trough_vals else "-",
+                sparkline(peak_vals, 16)))
+    return lines
+
+
+def render_calibration(data: Dict[str, Any]) -> List[str]:
+    calibration = data.get("calibration") or {}
+    table = calibration.get("table") or []
+    lines = ["== plan calibration (predicted vs measured step time)"]
+    if not table:
+        lines.append("  (no calibrated plans yet)")
+        return lines
+    lines.append("  {:<16} {:>5} {:>6} {:>12} {:>12} {:>7} {:>8}".format(
+        "mesh[d,dp,f,t,p]", "chips", "batch", "predicted_s",
+        "measured_s", "ratio", "samples"))
+    for entry in table:
+        marker = "*" if entry.get("current") else " "
+        lines.append(
+            " {}{:<16} {:>5} {:>6} {:>12} {:>12} {:>7} {:>8}"
+            .format(marker, _mesh_compact(entry.get("mesh", {})),
+                    entry.get("total_devices", 0),
+                    entry.get("global_batch", 0),
+                    "%.6g" % float(entry.get("predicted_step_s", 0.0)),
+                    "%.6g" % float(entry.get("measured_step_s", 0.0)),
+                    f"{entry.get('ratio', 0.0):.2f}"
+                    if entry.get("ratio") else "-",
+                    entry.get("samples", 0)))
+    discounts = calibration.get("discounts") or {}
+    if discounts:
+        lines.append("  learned axis discounts: " + " ".join(
+            f"{axis}={value:.3f}"
+            for axis, value in sorted(discounts.items())))
+    return lines
+
+
+def render_diagnosis(data: Dict[str, Any]) -> List[str]:
+    reports = data.get("diagnosis") or []
+    lines = [f"== recent diagnosis ({len(reports)})"]
+    if not reports:
+        lines.append("  (none)")
+        return lines
+    ordered = sorted(reports, key=lambda r: r.get("ts", 0.0))
+    t0 = ordered[0].get("ts", 0.0)
+    for report in ordered:
+        worker = int(report.get("worker_id", -1))
+        target = f"w{worker}" if worker >= 0 else "job"
+        lines.append("  +{:7.1f}s {:<8} {:<18} {:<4} {}".format(
+            report.get("ts", 0.0) - t0,
+            str(report.get("severity", "?")),
+            str(report.get("rule", "?")), target,
+            str(report.get("summary", ""))).rstrip())
+    return lines
+
+
+def render_history(data: Dict[str, Any]) -> List[str]:
+    """Resize / promotion history: the goodput ledger's priced re-plans
+    and incarnations (live + flight), plus raw lifecycle events when a
+    flight dump carries them."""
+    goodput = data.get("goodput") or {}
+    lines = ["== resize / promotion history"]
+    rows = 0
+    for replan in goodput.get("replans", []) or []:
+        phases = replan.get("phases", {}) or {}
+        total = sum(float(v) for v in phases.values())
+        detail = " ".join(f"{phase}={float(seconds):.2f}s"
+                          for phase, seconds in sorted(phases.items()))
+        lines.append(
+            "  replan rank {} gen {}: {:.2f}s total  {}".format(
+                replan.get("rank", "?"), replan.get("generation", "?"),
+                total, detail).rstrip())
+        rows += 1
+    for index, inc in enumerate(goodput.get("incarnations", [])
+                                or [], 1):
+        lines.append(
+            "  incarnation #{} round={} world={} trigger={}".format(
+                index, inc.get("round", "?"),
+                inc.get("world", "?"), inc.get("reason", "?")))
+        rows += 1
+    for event in data.get("history", []) or []:
+        attrs = event.get("attrs", {})
+        detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items())
+                          if not isinstance(v, (dict, list)))
+        lines.append(f"  {event.get('name', '?')}: {detail}"[:100])
+        rows += 1
+    if not rows:
+        lines.append("  (none)")
+    return lines
+
+
+def render_store(data: Dict[str, Any]) -> List[str]:
+    stats = data.get("tsdb_stats") or {}
+    if not stats:
+        return []
+    return [
+        "== history store: {} series, {} raw points, {} tier buckets "
+        "(bound {:.1f}MiB)".format(
+            stats.get("series", 0), stats.get("raw_points", 0),
+            stats.get("tier_buckets", 0),
+            float(stats.get("memory_bound_bytes", 0)) / (1 << 20))]
+
+
+def render(data: Dict[str, Any]) -> str:
+    sections = [
+        render_vitals(data),
+        render_slices_section(data),
+        render_hbm(data),
+        render_calibration(data),
+        render_diagnosis(data),
+        render_history(data),
+        render_store(data),
+    ]
+    return "\n".join("\n".join(lines) for lines in sections if lines)
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+
+def _load_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"{path}: unreadable: {e}", file=sys.stderr)
+        return None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "top", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--master", default="",
+                        help="live master address (host:port)")
+    parser.add_argument("--flight", default="",
+                        help="flight-recorder dump file")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="live refresh cadence in seconds")
+    parser.add_argument("--window", type=float, default=900.0,
+                        help="history window queried per frame")
+    parser.add_argument("--once", action="store_true",
+                        help="render ONE frame to stdout (no ANSI "
+                             "clear, deterministic for a fixed input) "
+                             "and exit")
+    ns = parser.parse_args(argv)
+    if not (ns.master or ns.flight):
+        parser.error("one of --master / --flight is required")
+
+    if ns.flight:
+        payload = _load_json(ns.flight)
+        if payload is None:
+            return 2
+        print(render(collect_from_flight(payload, ns.flight)))
+        return 0
+
+    try:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(ns.master, node_id=-1)
+    except Exception as e:  # noqa: BLE001 — transport setup varies
+        print(f"master {ns.master}: {e}", file=sys.stderr)
+        return 2
+    try:
+        while True:
+            try:
+                frame = render(collect_from_master(
+                    client, window_s=ns.window))
+            except Exception as e:  # noqa: BLE001 — transport errors
+                print(f"master {ns.master}: unreachable: {e}",
+                      file=sys.stderr)
+                return 2
+            if ns.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(ns.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
