@@ -325,6 +325,14 @@ class MasterClient:
             msg.DatasetEpochInfo,
         ).epoch
 
+    def get_task_counts(self, dataset_name: str) -> Tuple[int, int]:
+        """(todo, doing) task counts of a registered dataset — progress
+        introspection for tools and tests (the servicer answered this
+        endpoint since PR 2; graftlint GL402 found it had no wrapper)."""
+        result = self._get_typed(
+            msg.TaskCounts(dataset_name=dataset_name), msg.TaskCounts)
+        return result.todo, result.doing
+
     # -- rendezvous -------------------------------------------------------
     @retry_rpc()
     def join_rendezvous(self, local_world_size: int,
@@ -392,6 +400,13 @@ class MasterClient:
                                  rdzv_name=rdzv_name),
             msg.CommWorld,
         )
+        if world.rdzv_name and world.rdzv_name != rdzv_name:
+            # the echo field guards against a cross-wired dispatch (a
+            # stale/misrouted response adopted as this rendezvous's
+            # world would re-form the wrong protocol's membership)
+            raise RuntimeError(
+                f"comm world for {world.rdzv_name!r}, "
+                f"asked for {rdzv_name!r}")
         return world.round, world.group, world.world
 
     @retry_rpc(retries=3)
@@ -449,7 +464,14 @@ class MasterClient:
             plan = json.loads(result.plan_json)
         except json.JSONDecodeError:
             return {}
-        return plan if isinstance(plan, dict) else {}
+        if not isinstance(plan, dict):
+            return {}
+        # the envelope's epoch/generation are authoritative (the plan
+        # dict predates them in old masters): staleness checks read the
+        # plan, so make sure the stamps are present on it
+        plan.setdefault("epoch", result.epoch)
+        plan.setdefault("generation", result.generation)
+        return plan
 
     @retry_rpc(retries=3)
     def get_restore_plan(self, rdzv_name: str = RendezvousName.TRAINING,
@@ -554,7 +576,11 @@ class MasterClient:
         calibration attributes the timing evidence by it (-1 =
         unknown, -2 = running the fallback mesh, see
         GlobalStepReport)."""
-        return self._report(msg.GlobalStepReport(
+        # timestamp is deliberately unread master-side: the speed
+        # window keys every delta on the MASTER clock (mixing sender
+        # clocks would put cross-host skew in steps/s); the field rides
+        # for wire-capture forensics only
+        return self._report(msg.GlobalStepReport(  # graftlint: disable=GL401
             node_id=self.node_id, step=step, timestamp=time.time(),
             node_rank=self.node_rank, step_time_s=step_time_s,
             data_wait_fraction=data_wait_fraction, mfu=mfu,
@@ -719,6 +745,18 @@ class MasterClient:
             msg.ParallelConfigRequest(node_id=self.node_id),
             msg.ParallelConfig,
         )
+
+    def report_scale_request(self, node_type: str, count: int,
+                             cpu: float = 0.0,
+                             memory_mb: float = 0.0) -> bool:
+        """Relay a manual scale plan to the master's job manager (the
+        ScalePlan-CRD analogue; the servicer answered this endpoint
+        since PR 2 — graftlint GL402 found it had no wrapper, leaving
+        tools no sanctioned way to request a resize)."""
+        return self._report(msg.ScaleRequest(
+            node_type=node_type, count=count, cpu=cpu,
+            memory_mb=memory_mb,
+        )).success
 
     def get_job_status(self) -> msg.JobStatus:
         return self._get_typed(msg.JobStatusRequest(), msg.JobStatus)

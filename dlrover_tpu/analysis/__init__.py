@@ -1,6 +1,6 @@
-"""graftlint: trace-safety + lock-discipline static analysis.
+"""graftlint: the fleet's contract suite as static analysis.
 
-Two AST passes purpose-built for this codebase's failure modes:
+AST passes purpose-built for this codebase's failure modes:
 
 - trace-safety (GL1xx): jitted step functions must be retrace-safe and
   donation-correct — elastic resharding breaks first at silent
@@ -8,6 +8,18 @@ Two AST passes purpose-built for this codebase's failure modes:
 - lock-discipline (GL2xx): the threaded master/agent components must
   follow a consistent lock discipline or failover races in exactly the
   window a chaos kill opens.
+- state-roundtrip (GL3xx): classes in the crash-consistent state
+  backend must export/restore every mutable attribute (or annotate it
+  ephemeral), with symmetric snapshot keys.
+- protocol-symmetry (GL4xx, cross-module): message fields, servicer
+  dispatch arms, client wrappers and constants.py contracts must agree
+  across common/messages, master/servicer+coord_service and
+  agent/master_client.
+- hot-path-blocking (GL5xx): no file I/O / sleep / RPC reachable —
+  even through helpers — under a gradient-path lock.
+- obs-drift (GL6xx, cross-artifact): docs/observability.md catalogs and
+  obs/tsdb.DASHBOARD_SERIES must match what the code actually emits,
+  both directions.
 
 Entry points: ``tools/graftlint.py`` (CLI + CI gate),
 ``run_analysis`` (library), ``tests/test_graftlint.py`` (tier-1 gate).
@@ -19,16 +31,30 @@ from dlrover_tpu.analysis.findings import (       # noqa: F401
     RULES,
     Rule,
     distinct_rule_ids,
+    rules_signature,
 )
 from dlrover_tpu.analysis.lock_discipline import (  # noqa: F401
     LockDisciplinePass,
+)
+from dlrover_tpu.analysis.obs_drift import (      # noqa: F401
+    check_obs_catalog,
+    parse_catalog,
+)
+from dlrover_tpu.analysis.protocol import (       # noqa: F401
+    check_protocol,
+    extract_protocol_facts,
 )
 from dlrover_tpu.analysis.runner import (         # noqa: F401
     AnalysisResult,
     analyze_file,
     load_baseline,
+    load_cache,
     run_analysis,
+    save_cache,
     write_baseline,
+)
+from dlrover_tpu.analysis.state_roundtrip import (  # noqa: F401
+    StateRoundtripPass,
 )
 from dlrover_tpu.analysis.trace_safety import (   # noqa: F401
     TraceSafetyPass,

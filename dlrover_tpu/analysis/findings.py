@@ -24,9 +24,16 @@ from typing import Dict, List, Optional, Sequence
 @dataclasses.dataclass(frozen=True)
 class Rule:
     rule_id: str
-    pass_name: str            # "trace-safety" | "lock-discipline"
+    pass_name: str            # "trace-safety" | "lock-discipline" |
+    #                           "state-roundtrip" | "protocol-symmetry" |
+    #                           "hot-path-blocking" | "obs-drift"
     title: str
     hint: str
+    version: int = 1          # bump when the rule's LOGIC changes: the
+    #                           version is part of every fingerprint, so
+    #                           stale baseline suppressions written
+    #                           against the old logic stop matching
+    #                           instead of silently masking new findings
 
 
 RULES: Dict[str, Rule] = {
@@ -104,8 +111,89 @@ RULES: Dict[str, Rule] = {
             "attribute, but no access ever holds a lock — either guard it "
             "or document why it is single-threaded.",
         ),
+        Rule(
+            "GL301", "state-roundtrip",
+            "mutable state attribute outside the export/restore pair",
+            "this class participates in the crash-consistent state "
+            "backend, but the attribute is neither touched by "
+            "export_state/restore_state (or _export_extra/"
+            "_restore_extra) nor annotated `# graftlint: "
+            "ephemeral(reason)` — a master failover silently loses it "
+            "(the PR 9 `_known_chips` class of bug).",
+        ),
+        Rule(
+            "GL302", "state-roundtrip",
+            "asymmetric export/restore key",
+            "a key one side of the snapshot roundtrip uses and the "
+            "other never mentions restores as a silently-empty default "
+            "after failover (or exports dead weight); make restore "
+            "consume every key export emits, and vice versa.",
+        ),
+        Rule(
+            "GL401", "protocol-symmetry",
+            "message field read on one side but never set on the other",
+            "the reader only ever sees the dataclass default — the "
+            "'sender predates the field' path, permanently; set the "
+            "field at the construction site (or delete it).",
+        ),
+        Rule(
+            "GL402", "protocol-symmetry",
+            "RPC endpoint without a client wrapper (or vice versa)",
+            "a request type dispatched by the servicer needs a "
+            "MasterClient wrapper (and a client-sent type needs a "
+            "dispatch arm), or one side of the protocol is "
+            "unreachable/unanswerable.",
+        ),
+        Rule(
+            "GL403", "protocol-symmetry",
+            "string literal shadows a constants.py contract",
+            "KV prefixes, env-var names and rendezvous names are "
+            "single-sourced in common/constants.py — a literal copy "
+            "drifts the moment the contract changes on one side only "
+            "(the HOT_KV_PREFIXES lesson from PR 10); import the "
+            "constant.",
+        ),
+        Rule(
+            "GL501", "hot-path-blocking",
+            "blocking operation reachable under a gradient-path lock",
+            "file I/O, sleeps, RPCs or subprocesses while a hot lock "
+            "(KV store condition, mutation log, dcn sync, step "
+            "timeline) is held — lexically or via a helper called with "
+            "the lock held — put storage/network latency in the "
+            "per-step path; move the slow call outside the critical "
+            "section (the PR 10 mutation-log lesson).",
+        ),
+        Rule(
+            "GL601", "obs-drift",
+            "documented observability name not emitted by code",
+            "docs/observability.md catalogs a metric/span/flight-event "
+            "that nothing registers or emits — either the code lost it "
+            "or the docs invented it; reconcile.",
+        ),
+        Rule(
+            "GL602", "obs-drift",
+            "emitted observability name missing from the catalog",
+            "a metric/span/flight-event the code emits has no row in "
+            "docs/observability.md — operators can't discover it and "
+            "the next rename drifts silently; add the catalog row.",
+        ),
+        Rule(
+            "GL603", "obs-drift",
+            "DASHBOARD_SERIES entry not backed by an emitted series",
+            "tools/top.py and the flight snapshot query this name but "
+            "nothing ingests or registers it — the dashboard column "
+            "renders empty forever; fix the name or the feed.",
+        ),
     ]
 }
+
+
+def rules_signature() -> str:
+    """Stable digest over (rule_id, version) pairs — the cache and
+    baseline invalidation key: any rule addition/removal/version bump
+    re-analyzes everything."""
+    raw = ";".join(f"{rid}:{RULES[rid].version}" for rid in sorted(RULES))
+    return hashlib.sha1(raw.encode()).hexdigest()[:12]
 
 
 @dataclasses.dataclass
@@ -123,7 +211,11 @@ class Finding:
 
     def fingerprint(self, source_line: str = "") -> str:
         norm = re.sub(r"\s+", " ", source_line.strip())
-        raw = f"{self.rule_id}|{self.path}|{self.symbol}|{norm}"
+        # the rule VERSION is part of the hash: bumping a rule's logic
+        # invalidates that rule's baseline suppressions instead of
+        # letting stale entries mask findings the new logic surfaces
+        raw = (f"{self.rule_id}v{self.rule.version}"
+               f"|{self.path}|{self.symbol}|{norm}")
         return hashlib.sha1(raw.encode()).hexdigest()[:16]
 
     def format(self) -> str:
@@ -134,6 +226,21 @@ class Finding:
 
 _PRAGMA_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9, ]+)")
 _SKIP_FILE_RE = re.compile(r"#\s*graftlint:\s*skip-file")
+# `self._scratch = {}  # graftlint: ephemeral(rebuilt on restore)` —
+# the state-roundtrip pass's opt-out: the attribute is DELIBERATELY
+# not part of the snapshot, and the reason is recorded in-line. A bare
+# `ephemeral` with no reason does not count: the why is the contract.
+_EPHEMERAL_RE = re.compile(r"#\s*graftlint:\s*ephemeral\(([^)]+)\)")
+
+
+def ephemeral_lines(source_lines: Sequence[str]) -> Dict[int, str]:
+    """1-based line -> ephemeral reason for annotated lines."""
+    out: Dict[int, str] = {}
+    for i, ln in enumerate(source_lines, start=1):
+        m = _EPHEMERAL_RE.search(ln)
+        if m and m.group(1).strip():
+            out[i] = m.group(1).strip()
+    return out
 
 
 def file_skipped(source_lines: Sequence[str]) -> bool:

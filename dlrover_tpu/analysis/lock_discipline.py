@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from dlrover_tpu.analysis.findings import Finding
@@ -47,6 +48,29 @@ _BLOCKING_PREFIX = ("subprocess.", "requests.", "urllib.request.",
                     "socket.create_connection")
 _THREADY = ("thread", "proc", "worker", "server")
 _SKIP_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
+
+# -- GL501: the gradient-path lock owners ----------------------------------
+# Classes/modules whose locks sit on per-step paths: the KV store's
+# condition (every dcn/ exchange), the mutation log it calls into, the
+# cross-slice sync, and the per-step timeline. Blocking ops under THESE
+# locks — including via "(lock held)" helpers — are per-step stalls.
+# `# graftlint: hot-path` on a class def line opts additional classes in.
+_HOT_CLASS_NAMES = {"KVStoreService", "MutationLog", "SliceGradSync",
+                    "StepTimeline"}
+_HOT_MODULE_SUFFIXES = ("parallel/dcn_sync.py",)
+_HOT_MARKER_RE = re.compile(r"#\s*graftlint:\s*hot-path\b")
+
+# the EXTENDED blocking vocabulary GL501 adds on top of GL203's: file
+# I/O, fsync/rename, socket traffic and RPC-ish client calls — things
+# that are fine under an ordinary lock but not under a hot one
+_BLOCKING_OS_EXACT = {"os.fsync", "os.replace", "os.rename",
+                      "os.remove", "os.fdatasync"}
+_FILEY_RECEIVERS = ("file", "sock", "conn", "log", "_fh", "_fd")
+_FILEY_METHODS = {"write", "flush", "read", "readline", "readlines",
+                  "truncate", "seek", "close"}
+_SOCKY_METHODS = {"send", "sendall", "recv", "recv_into", "connect",
+                  "accept"}
+_RPC_RECEIVERS = ("client", "stub", "channel")
 
 # guard inference thresholds: an attribute is "guarded by L" when at least
 # _MIN_GUARDED accesses hold L and they are at least _GUARDED_RATIO of all
@@ -89,6 +113,32 @@ class _ModuleOwner:
         return None
 
 
+def group_class_families(
+        classes: List[ast.ClassDef]
+) -> List[Tuple[str, List[ast.ClassDef]]]:
+    """Union-find grouping of classes with their same-module bases —
+    shared by the lock-discipline and state-roundtrip passes so both
+    see inherited helpers (``_export_extra`` overrides, private
+    lock-held helpers) next to their base-class call sites."""
+    by_name = {c.name: c for c in classes}
+    parent: Dict[str, str] = {c.name: c.name for c in classes}
+
+    def find(x: str) -> str:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for c in classes:
+        for base in c.bases:
+            if isinstance(base, ast.Name) and base.id in by_name:
+                parent[find(c.name)] = find(base.id)
+    groups: Dict[str, List[ast.ClassDef]] = {}
+    for c in classes:
+        groups.setdefault(find(c.name), []).append(c)
+    return sorted(groups.items())
+
+
 def _module_lock_names(tree: ast.Module,
                        aliases: Dict[str, str]) -> Set[str]:
     """Names bound to threading lock objects at module scope."""
@@ -115,6 +165,12 @@ class _MethodScan(ast.NodeVisitor):
         self.calls: List[_CallSite] = []
         self.order_pairs: List[Tuple[str, str, ast.AST, str]] = []
         self.blocking: List[Tuple[str, ast.Call, Tuple[str, ...]]] = []
+        # EVERY blocking-ish call (classic + extended vocabulary),
+        # recorded regardless of the lexical lockset so the hot-path
+        # pass can join it with the method's interprocedural entry
+        # lockset: (name, kind, node, lexically_held, in_nested_def)
+        self.blocking_all: List[Tuple[str, str, ast.Call,
+                                      Tuple[str, ...], bool]] = []
         self.bare_acquires: List[ast.Call] = []
         self._nested_depth = 0
 
@@ -203,11 +259,51 @@ class _MethodScan(ast.NodeVisitor):
                 # expressed as a `with` statement — only the bare form is
                 # the discipline violation
                 self.bare_acquires.append(node)
-        if self.held:
-            name = self._blocking_name(node)
-            if name:
-                self.blocking.append((name, node, tuple(self.held)))
+        name = self._blocking_name(node)
+        if name and self.held:
+            self.blocking.append((name, node, tuple(self.held)))
+        if name:
+            self.blocking_all.append((name, "blocking", node,
+                                      tuple(self.held),
+                                      self._nested_depth > 0))
+        else:
+            ext = self._extended_blocking(node)
+            if ext:
+                ext_name, kind = ext
+                self.blocking_all.append((ext_name, kind, node,
+                                          tuple(self.held),
+                                          self._nested_depth > 0))
         self.generic_visit(node)
+
+    def _extended_blocking(
+            self, node: ast.Call) -> Optional[Tuple[str, str]]:
+        """GL501's wider vocabulary: file I/O, fsync/rename, socket
+        traffic and RPC-ish client calls — acceptable under an ordinary
+        lock, never under a gradient-path one."""
+        head = _dotted_name(node.func, self.owner.aliases)
+        if head == "open":
+            return "open", "file I/O"
+        if head in _BLOCKING_OS_EXACT:
+            return head, "file I/O"
+        if head and head.startswith("socket."):
+            return head, "socket"
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            text = ""
+            if isinstance(recv, ast.Attribute):
+                text = recv.attr.lower()
+            elif isinstance(recv, ast.Name):
+                text = recv.id.lower()
+            meth = node.func.attr
+            if meth in _SOCKY_METHODS and any(
+                    t in text for t in ("sock", "conn")):
+                return f"{text}.{meth}", "socket"
+            if meth in _FILEY_METHODS and any(
+                    t in text for t in _FILEY_RECEIVERS):
+                return f"{text}.{meth}", "file I/O"
+            if any(t in text for t in _RPC_RECEIVERS):
+                return f"{text}.{meth}", "RPC"
+        return None
 
     def _blocking_name(self, node: ast.Call) -> Optional[str]:
         head = _dotted_name(node.func, self.owner.aliases)
@@ -306,16 +402,24 @@ class LockDisciplinePass:
         findings: List[Finding] = []
         order_pairs: List[Tuple[str, str, ast.AST, str]] = []
         module_locks = _module_lock_names(tree, aliases)
+        marker_lines = {i for i, ln in enumerate(source_lines, start=1)
+                        if _HOT_MARKER_RE.search(ln)}
+        hot_module = relpath.endswith(_HOT_MODULE_SUFFIXES)
 
         classes = [n for n in tree.body if isinstance(n, ast.ClassDef)]
         for family in self._families(classes, aliases, relpath,
                                      module_locks):
             if not family.owns_locks() and not module_locks:
                 continue
+            hot = hot_module or any(
+                cls.name in _HOT_CLASS_NAMES
+                or cls.lineno in marker_lines
+                for cls in family.classes)
             findings.extend(
-                self._analyze_family(family, order_pairs))
+                self._analyze_family(family, order_pairs, hot=hot))
         findings.extend(self._module_level(tree, aliases, relpath,
-                                           module_locks, order_pairs))
+                                           module_locks, order_pairs,
+                                           hot=hot_module))
         findings.extend(self._inversions(order_pairs, relpath))
         return findings
 
@@ -323,31 +427,16 @@ class LockDisciplinePass:
     def _families(self, classes: List[ast.ClassDef],
                   aliases: Dict[str, str], relpath: str,
                   module_locks: Set[str]) -> List[_ClassFamily]:
-        by_name = {c.name: c for c in classes}
-        parent: Dict[str, str] = {c.name: c.name for c in classes}
-
-        def find(x: str) -> str:
-            while parent[x] != x:
-                parent[x] = parent[parent[x]]
-                x = parent[x]
-            return x
-
-        for c in classes:
-            for base in c.bases:
-                if isinstance(base, ast.Name) and base.id in by_name:
-                    parent[find(c.name)] = find(base.id)
-        groups: Dict[str, List[ast.ClassDef]] = {}
-        for c in classes:
-            groups.setdefault(find(c.name), []).append(c)
         return [
             _ClassFamily(root, members, aliases, relpath, module_locks)
-            for root, members in groups.items()
+            for root, members in group_class_families(classes)
         ]
 
     # -- per-family analysis ----------------------------------------------
     def _analyze_family(
             self, family: _ClassFamily,
-            order_pairs: List[Tuple[str, str, ast.AST, str]]
+            order_pairs: List[Tuple[str, str, ast.AST, str]],
+            hot: bool = False,
     ) -> List[Finding]:
         findings: List[Finding] = []
         scans: Dict[str, _MethodScan] = {}
@@ -384,6 +473,10 @@ class LockDisciplinePass:
 
         entries = self._entry_locksets(family, scans)
 
+        if hot:
+            findings.extend(self._hot_path_blocking(family, scans,
+                                                    entries))
+
         # effective locksets per access
         accesses: List[_Access] = []
         for key, scan in scans.items():
@@ -401,6 +494,38 @@ class LockDisciplinePass:
 
         findings.extend(self._infer_guards(family, accesses))
         findings.extend(self._never_guarded(family, accesses))
+        return findings
+
+    # -- GL501 --------------------------------------------------------------
+    def _hot_path_blocking(
+            self, family: _ClassFamily,
+            scans: Dict[str, "_MethodScan"],
+            entries: Dict[str, frozenset]) -> List[Finding]:
+        """Blocking ops (extended vocabulary) whose EFFECTIVE lockset —
+        lexical ∪ the method's interprocedural entry lockset — is
+        non-empty, in a gradient-path lock owner. The entry-lockset
+        machinery is the same "(lock held)" helper propagation GL201
+        uses, so indirection can't hide a sync write."""
+        findings: List[Finding] = []
+        for key, scan in scans.items():
+            meth_name = key.split(".", 1)[1]
+            entry = entries.get(meth_name, frozenset())
+            for name, kind, node, held, nested in scan.blocking_all:
+                effective = set(held)
+                if not nested:
+                    effective |= entry
+                if not effective:
+                    continue
+                if kind == "blocking" and held:
+                    continue      # GL203 already reports this one
+                via = ("" if held else
+                       " (lock held at every call site of this helper)")
+                findings.append(Finding(
+                    "GL501", family.relpath, node.lineno,
+                    node.col_offset,
+                    f"{kind} `{name}` under gradient-path lock "
+                    f"{', '.join(sorted(effective))} in {key}{via} — "
+                    f"a per-step stall", symbol=key))
         return findings
 
     def _entry_locksets(
@@ -497,7 +622,8 @@ class LockDisciplinePass:
     def _module_level(
             self, tree: ast.Module, aliases: Dict[str, str], relpath: str,
             lock_names: Set[str],
-            order_pairs: List[Tuple[str, str, ast.AST, str]]
+            order_pairs: List[Tuple[str, str, ast.AST, str]],
+            hot: bool = False,
     ) -> List[Finding]:
         """Module-level functions using module-level locks, analyzed with
         the SAME _MethodScan walker the class pass uses (one copy of the
@@ -520,6 +646,16 @@ class LockDisciplinePass:
                     f"blocking call `{name}` while holding "
                     f"{', '.join(held)} in {node.name}",
                     symbol=node.name))
+            if hot:
+                for name, kind, cnode, held, _ in scan.blocking_all:
+                    if not held or (kind == "blocking" and held):
+                        continue
+                    findings.append(Finding(
+                        "GL501", relpath, cnode.lineno,
+                        cnode.col_offset,
+                        f"{kind} `{name}` under gradient-path lock "
+                        f"{', '.join(sorted(held))} in {node.name} — "
+                        f"a per-step stall", symbol=node.name))
             for cnode in scan.bare_acquires:
                 findings.append(Finding(
                     "GL204", relpath, cnode.lineno, cnode.col_offset,

@@ -1,0 +1,514 @@
+"""Pass 4: cross-module protocol-symmetry analysis (GL4xx).
+
+The control-plane protocol lives in four places that must agree:
+``common/messages.py`` (the dataclass vocabulary), ``master/servicer.py``
++ ``master/coord_service.py`` (the dispatch side), and
+``agent/master_client.py`` (the typed wrappers). PR 10's
+``HOT_KV_PREFIXES`` single-sourcing exists because a contract changed on
+one side only; this pass proves three symmetries mechanically:
+
+GL401  a message field read on one side but never set at any
+       construction site on the other (the reader only ever sees the
+       dataclass default), and the reverse — a field set at
+       construction that nothing anywhere reads.
+GL402  a request type the servicer dispatches with no MasterClient
+       wrapper constructing it (the endpoint is unreachable from
+       agents/tools), or a client-sent type no servicer dispatches
+       (the wrapper can only ever get "unknown request").
+GL403  a string literal in a protocol module that equals a
+       ``common/constants.py`` contract value (KV prefixes, env-var
+       names, rendezvous names) instead of importing the constant.
+
+Unlike the other passes this one is interprocedural ACROSS FILES: the
+per-file half (:func:`extract_protocol_facts`) distills each module into
+a small JSON-serializable fact record (cached by the runner alongside
+findings), and the project half (:func:`check_protocol`) diffs the
+records. Evidence rules are deliberately conservative — reads bind to a
+message class only through ``isinstance`` guards, parameter annotations,
+construction assignments and ``_get_typed``-style expected-type calls;
+everything else (``x.field`` on an unknown object, ``getattr`` with a
+constant name) counts as a WEAK read that can suppress a "never read"
+finding but never raise one. A class constructed with positional args,
+``*``/``**`` splats or ``dataclasses.replace`` is treated as fully set.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dlrover_tpu.analysis.findings import Finding
+
+# relpath suffixes → module roles (fixture packages mirror the layout)
+MESSAGES_SUFFIX = "common/messages.py"
+SERVER_SUFFIXES = ("master/servicer.py", "master/coord_service.py")
+CLIENT_SUFFIX = "agent/master_client.py"
+CONSTANTS_SUFFIX = "common/constants.py"
+# modules whose string literals are checked against the contract
+# (GL403): the protocol modules plus the KV store, which implements the
+# hot-prefix contract the constants single-source
+LITERAL_SUFFIXES = SERVER_SUFFIXES + (
+    MESSAGES_SUFFIX, CLIENT_SUFFIX, "master/kv_store.py")
+
+# calls whose bare message-class argument types their result
+_EXPECTED_TYPE_CALLS = {"_get_typed", "_report_typed", "_typed",
+                        "deserialize_expecting"}
+
+
+def _has_role(relpath: str, suffixes) -> bool:
+    if isinstance(suffixes, str):
+        suffixes = (suffixes,)
+    return any(relpath == s or relpath.endswith("/" + s)
+               for s in suffixes)
+
+
+def _line(source_lines: Sequence[str], lineno: int) -> str:
+    if 1 <= lineno <= len(source_lines):
+        return source_lines[lineno - 1]
+    return ""
+
+
+def _msg_class_name(expr: ast.AST) -> Optional[str]:
+    """``msg.X`` / bare ``X`` (capitalized) → "X"; anything else None."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                      ast.Name):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return None
+    return name if name[:1].isupper() else None
+
+
+def _contract_worthy(value: str) -> bool:
+    """Distinctive contract strings only — generic words ("worker",
+    "running") would drown the pass in incidental matches."""
+    return len(value) >= 4 and any(c in value for c in "/-_")
+
+
+class _FactVisitor(ast.NodeVisitor):
+    """One walk collecting every evidence kind; class bindings for
+    local names are maintained as a scope stack keyed per function."""
+
+    def __init__(self, relpath: str, source_lines: Sequence[str],
+                 facts: Dict):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.facts = facts
+        self._bindings: List[Dict[str, str]] = [{}]
+
+    # -- binding helpers ---------------------------------------------------
+    def _bind(self, name: str, cls: str) -> None:
+        self._bindings[-1][name] = cls
+
+    def _lookup(self, name: str) -> Optional[str]:
+        for frame in reversed(self._bindings):
+            if name in frame:
+                return frame[name]
+        return None
+
+    # -- scopes ------------------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_function(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_function(node)
+
+    def _enter_function(self, node) -> None:
+        self._bindings.append({})
+        for arg in node.args.posonlyargs + node.args.args + \
+                node.args.kwonlyargs:
+            if arg.annotation is not None:
+                cls = _msg_class_name(arg.annotation)
+                if cls:
+                    self._bind(arg.arg, cls)
+        self.generic_visit(node)
+        self._bindings.pop()
+
+    def visit_If(self, node: ast.If) -> None:
+        """``if isinstance(request, msg.X):`` binds request→X in the
+        body (the servicer's dispatch idiom)."""
+        self.visit(node.test)
+        bound = self._isinstance_binding(node.test)
+        if bound is not None:
+            name, cls = bound
+            self._bindings.append({name: cls})
+            for stmt in node.body:
+                self.visit(stmt)
+            self._bindings.pop()
+        else:
+            for stmt in node.body:
+                self.visit(stmt)
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def _isinstance_binding(
+            self, test: ast.AST) -> Optional[Tuple[str, str]]:
+        if (isinstance(test, ast.Call)
+                and isinstance(test.func, ast.Name)
+                and test.func.id == "isinstance"
+                and len(test.args) == 2
+                and isinstance(test.args[0], ast.Name)):
+            cls = _msg_class_name(test.args[1])
+            if cls:
+                self._record_dispatch(cls, test)
+                return test.args[0].id, cls
+            # isinstance against a tuple still counts as dispatch
+            if isinstance(test.args[1], ast.Tuple):
+                for el in test.args[1].elts:
+                    sub = _msg_class_name(el)
+                    if sub:
+                        self._record_dispatch(sub, test)
+        return None
+
+    def _record_dispatch(self, cls: str, node: ast.AST) -> None:
+        self.facts["dispatch"].setdefault(cls, []).append(
+            [node.lineno, node.col_offset,
+             _line(self.lines, node.lineno)])
+
+    # -- constructions / typed calls / assignments -------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        cls = self._value_class(node.value)
+        if cls:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self._bind(tgt.id, cls)
+        self.generic_visit(node)
+
+    def _value_class(self, value: ast.AST) -> Optional[str]:
+        """The message class a value expression produces, if knowable:
+        a construction ``msg.X(...)`` or an expected-type call."""
+        if not isinstance(value, ast.Call):
+            return None
+        cls = _msg_class_name(value.func)
+        if cls:
+            return cls
+        if isinstance(value.func, ast.Attribute) and \
+                value.func.attr in _EXPECTED_TYPE_CALLS:
+            expected = None
+            for arg in list(value.args) + [kw.value
+                                           for kw in value.keywords]:
+                if not isinstance(arg, ast.Call):
+                    sub = _msg_class_name(arg)
+                    if sub:
+                        expected = sub
+            return expected
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        cls = _msg_class_name(node.func)
+        if cls:
+            kwargs = [kw.arg for kw in node.keywords if kw.arg]
+            opaque = bool(node.args) or any(
+                kw.arg is None for kw in node.keywords)
+            self.facts["constructions"].setdefault(cls, []).append(
+                [node.lineno, node.col_offset, sorted(kwargs),
+                 opaque, _line(self.lines, node.lineno)])
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "replace":
+            # dataclasses.replace(current, ...): treat the bound class
+            # of the first arg (if known) as opaquely constructed
+            if node.args and isinstance(node.args[0], ast.Name):
+                bound = self._lookup(node.args[0].id)
+                if bound:
+                    self.facts["constructions"].setdefault(
+                        bound, []).append(
+                        [node.lineno, node.col_offset, [], True,
+                         _line(self.lines, node.lineno)])
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id == "getattr" and len(node.args) >= 2 and \
+                isinstance(node.args[1], ast.Constant) and \
+                isinstance(node.args[1].value, str):
+            field = node.args[1].value
+            if node.args and isinstance(node.args[0], ast.Name):
+                bound = self._lookup(node.args[0].id)
+                if bound:
+                    self.facts["reads"].setdefault(bound, []).append(
+                        [field, node.lineno, node.col_offset,
+                         _line(self.lines, node.lineno)])
+                else:
+                    self.facts["weak_reads"].append(field)
+            else:
+                self.facts["weak_reads"].append(field)
+        self.generic_visit(node)
+
+    # -- attribute reads ---------------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.ctx, ast.Load):
+            if isinstance(node.value, ast.Name):
+                bound = self._lookup(node.value.id)
+                if bound:
+                    self.facts["reads"].setdefault(bound, []).append(
+                        [node.attr, node.lineno, node.col_offset,
+                         _line(self.lines, node.lineno)])
+                elif node.value.id not in ("self", "cls"):
+                    self.facts["weak_reads"].append(node.attr)
+            elif isinstance(node.value, ast.Call):
+                # chained read on a typed call: _get_typed(..., msg.X).f
+                cls = self._value_class(node.value)
+                if cls:
+                    self.facts["reads"].setdefault(cls, []).append(
+                        [node.attr, node.lineno, node.col_offset,
+                         _line(self.lines, node.lineno)])
+                else:
+                    self.facts["weak_reads"].append(node.attr)
+            else:
+                self.facts["weak_reads"].append(node.attr)
+        self.generic_visit(node)
+
+
+def _collect_message_fields(tree: ast.Module) -> Dict[str, List[str]]:
+    """Dataclass field vocabulary: annotated class-body assignments of
+    classes (transitively) deriving from the module's Message base."""
+    classes = {n.name: n for n in tree.body
+               if isinstance(n, ast.ClassDef)}
+
+    def is_message(name: str, seen: Set[str]) -> bool:
+        if name == "Message":
+            return True
+        node = classes.get(name)
+        if node is None or name in seen:
+            return False
+        return any(
+            isinstance(b, ast.Name) and is_message(b.id, seen | {name})
+            for b in node.bases)
+
+    out: Dict[str, List[str]] = {}
+    for name, node in classes.items():
+        if name == "Message" or not is_message(name, set()):
+            continue
+        fields = []
+        for item in node.body:
+            if isinstance(item, ast.AnnAssign) and isinstance(
+                    item.target, ast.Name):
+                fields.append(item.target.id)
+        out[name] = fields
+    return out
+
+
+def _collect_contract_constants(tree: ast.Module) -> Dict[str, str]:
+    """value → qualified constant name for the single-sourced contract
+    strings: class-attribute strings and module-level tuple elements."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.Assign) and isinstance(
+                        item.value, ast.Constant) and isinstance(
+                        item.value.value, str):
+                    value = item.value.value
+                    if _contract_worthy(value):
+                        for tgt in item.targets:
+                            if isinstance(tgt, ast.Name):
+                                out.setdefault(
+                                    value, f"{node.name}.{tgt.id}")
+        elif isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.Tuple, ast.List)):
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                for el in node.value.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                            el.value, str) and _contract_worthy(el.value):
+                        out.setdefault(el.value, tgt.id)
+    return out
+
+
+def _collect_literals(tree: ast.Module,
+                      source_lines: Sequence[str]) -> List[List]:
+    """Standalone string constants in expressions (docstrings and
+    standalone-Expr strings excluded)."""
+    docstrings = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                    body[0].value, ast.Constant):
+                docstrings.add(id(body[0].value))
+    out: List[List] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(
+                node.value, str) and id(node) not in docstrings:
+            if _contract_worthy(node.value):
+                out.append([node.value, node.lineno, node.col_offset,
+                            _line(source_lines, node.lineno)])
+    return out
+
+
+def extract_protocol_facts(relpath: str, tree: ast.Module,
+                           source_lines: Sequence[str]) -> Dict:
+    """The per-file half: a JSON-serializable fact record the runner
+    caches beside the file's findings."""
+    facts: Dict = {
+        "constructions": {}, "reads": {}, "weak_reads": [],
+        "dispatch": {},
+    }
+    visitor = _FactVisitor(relpath, source_lines, facts)
+    visitor.visit(tree)
+    facts["weak_reads"] = sorted(set(facts["weak_reads"]))
+    if _has_role(relpath, CLIENT_SUFFIX):
+        # every message-class NAME the client module references —
+        # `msg.X` attribute style AND directly-imported bare names
+        # (constructions, annotations, expected-type args): a wrapper
+        # may take the message as a typed parameter instead of
+        # constructing it — that still reaches the endpoint. Bare-name
+        # collection is deliberately broad (any capitalized loaded
+        # name): refs only SUPPRESS GL402, and a non-message name can
+        # never match a dispatched message class by accident.
+        refs = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.value, ast.Name):
+                cls = _msg_class_name(node)
+                if cls:
+                    refs.add(cls)
+            elif isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load) and node.id[:1].isupper():
+                refs.add(node.id)
+        facts["class_refs"] = sorted(refs)
+    if _has_role(relpath, MESSAGES_SUFFIX):
+        facts["message_fields"] = _collect_message_fields(tree)
+    if _has_role(relpath, CONSTANTS_SUFFIX):
+        facts["contract_constants"] = _collect_contract_constants(tree)
+    if _has_role(relpath, LITERAL_SUFFIXES):
+        facts["literals"] = _collect_literals(tree, source_lines)
+    facts["roles"] = {
+        "messages": _has_role(relpath, MESSAGES_SUFFIX),
+        "server": _has_role(relpath, SERVER_SUFFIXES),
+        "client": _has_role(relpath, CLIENT_SUFFIX),
+    }
+    return facts
+
+
+def check_protocol(
+        facts_by_path: Dict[str, Dict]
+) -> List[Tuple[Finding, str]]:
+    """The project half: diff the per-file fact records. Returns
+    (finding, source_line) pairs — the caller fingerprints and applies
+    that file's pragmas."""
+    message_fields: Dict[str, Set[str]] = {}
+    for facts in facts_by_path.values():
+        for cls, fields in (facts.get("message_fields") or {}).items():
+            message_fields.setdefault(cls, set()).update(fields)
+    if not message_fields:
+        return []        # no message vocabulary in the analyzed roots
+
+    # pooled evidence across every analyzed module
+    set_fields: Dict[str, Set[str]] = {}
+    opaque_classes: Set[str] = set()
+    constructions: Dict[str, List[Tuple[str, List]]] = {}
+    reads: Dict[str, List[Tuple[str, List]]] = {}
+    weak_reads: Set[str] = set()
+    dispatch: Dict[str, List[Tuple[str, List]]] = {}
+    client_sent: Dict[str, List[Tuple[str, List]]] = {}
+    client_refs: Set[str] = set()
+    contract: Dict[str, str] = {}
+    literals: List[Tuple[str, List]] = []
+
+    for path, facts in sorted(facts_by_path.items()):
+        roles = facts.get("roles") or {}
+        for cls, sites in (facts.get("constructions") or {}).items():
+            if cls not in message_fields:
+                continue
+            for site in sites:
+                constructions.setdefault(cls, []).append((path, site))
+                set_fields.setdefault(cls, set()).update(site[2])
+                if site[3]:
+                    opaque_classes.add(cls)
+                if roles.get("client"):
+                    client_sent.setdefault(cls, []).append((path, site))
+        for cls, sites in (facts.get("reads") or {}).items():
+            if cls not in message_fields:
+                continue
+            for site in sites:
+                reads.setdefault(cls, []).append((path, site))
+        weak_reads.update(facts.get("weak_reads") or ())
+        client_refs.update(facts.get("class_refs") or ())
+        if roles.get("server"):
+            for cls, sites in (facts.get("dispatch") or {}).items():
+                if cls not in message_fields:
+                    continue
+                for site in sites:
+                    dispatch.setdefault(cls, []).append((path, site))
+        contract.update(facts.get("contract_constants") or {})
+        for lit in facts.get("literals") or ():
+            literals.append((path, lit))
+
+    out: List[Tuple[Finding, str]] = []
+
+    # -- GL401: read but never set --------------------------------------
+    for cls in sorted(reads):
+        if cls not in constructions or cls in opaque_classes:
+            continue      # nothing constructs it here / can't enumerate
+        for path, (field, line, col, srcline) in sorted(reads[cls]):
+            if field not in message_fields[cls]:
+                continue  # property / method access, not a field
+            if field in set_fields.get(cls, ()):
+                continue
+            out.append((Finding(
+                "GL401", path, line, col,
+                f"{cls}.{field} is read here but never set at any "
+                f"construction site — the reader only ever sees the "
+                f"dataclass default", symbol=f"{cls}.{field}"),
+                srcline))
+
+    # -- GL401: set but never read --------------------------------------
+    read_fields: Dict[str, Set[str]] = {}
+    for cls, sites in reads.items():
+        read_fields.setdefault(cls, set()).update(
+            site[0] for _, site in sites)
+    for cls in sorted(constructions):
+        strong = read_fields.get(cls, set())
+        for path, (line, col, kwargs, opaque, srcline) in sorted(
+                constructions[cls]):
+            for field in kwargs:
+                if field in strong or field in weak_reads:
+                    continue
+                out.append((Finding(
+                    "GL401", path, line, col,
+                    f"{cls}.{field} is set at this construction but "
+                    f"never read anywhere in the analyzed modules",
+                    symbol=f"{cls}.{field}"), srcline))
+
+    # -- GL402: endpoint ↔ wrapper symmetry -----------------------------
+    has_client = any((f.get("roles") or {}).get("client")
+                     for f in facts_by_path.values())
+    has_server = any((f.get("roles") or {}).get("server")
+                     for f in facts_by_path.values())
+    # a recorded client-side construction is the strongest wrapper
+    # evidence of all — belt over the refs braces
+    client_refs.update(client_sent)
+    if has_client:
+        for cls in sorted(dispatch):
+            if cls in client_refs:
+                continue
+            path, (line, col, srcline) = sorted(dispatch[cls])[0]
+            out.append((Finding(
+                "GL402", path, line, col,
+                f"request type {cls} is dispatched here but "
+                f"MasterClient never constructs it — no client wrapper "
+                f"can reach this endpoint", symbol=cls), srcline))
+    if has_server:
+        for cls in sorted(client_sent):
+            if cls in dispatch:
+                continue
+            path, site = sorted(client_sent[cls])[0]
+            line, col, srcline = site[0], site[1], site[4]
+            out.append((Finding(
+                "GL402", path, line, col,
+                f"client-sent type {cls} has no servicer dispatch arm "
+                f"— the wrapper can only receive 'unknown request'",
+                symbol=cls), srcline))
+
+    # -- GL403: contract literal shadowing ------------------------------
+    for path, (value, line, col, srcline) in sorted(literals):
+        const = contract.get(value)
+        if const is None:
+            continue
+        out.append((Finding(
+            "GL403", path, line, col,
+            f"string literal {value!r} shadows the constants.py "
+            f"contract {const} — import the constant",
+            symbol=const), srcline))
+    return out

@@ -1,10 +1,22 @@
-"""graftlint driver: walk a package, run both passes, apply baseline.
+"""graftlint driver: walk a package, run every pass, apply baseline.
+
+Per-file passes (trace-safety, lock-discipline + hot-path, state-
+roundtrip) and per-file FACT extraction (protocol + obs emission sites)
+run once per file and are cached; the cross-module checkers (protocol
+symmetry, obs-catalog drift) then run over the pooled facts — so a
+warm-cache whole-package run re-parses only changed files and stays
+fast as the repo grows.
 
 The baseline file (tools/graftlint_baseline.json) holds fingerprints of
-accepted pre-existing findings; the gate fails only on findings NOT in the
-baseline, so the analyzer can be adopted incrementally without a
+accepted pre-existing findings; the gate fails only on findings NOT in
+the baseline, so the analyzer can be adopted incrementally without a
 flag-day cleanup (and the tier-1 test stays green while still catching
-every *new* violation).
+every *new* violation). Fingerprints embed each rule's VERSION, so
+bumping a rule's logic invalidates its stale suppressions.
+
+The cache (tools/.graftlint_cache.json) keys each file on
+(path, mtime_ns, size) under a global rules-signature: any rule
+addition/removal/version bump discards the whole cache.
 """
 
 from __future__ import annotations
@@ -13,19 +25,32 @@ import ast
 import dataclasses
 import json
 import os
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from dlrover_tpu.analysis.findings import (
     Finding,
     apply_pragmas,
     file_skipped,
+    line_pragmas,
+    rules_signature,
     sort_findings,
     source_line,
 )
 from dlrover_tpu.analysis.lock_discipline import LockDisciplinePass
+from dlrover_tpu.analysis.obs_drift import (
+    check_obs_catalog,
+    extract_obs_facts,
+)
+from dlrover_tpu.analysis.protocol import (
+    check_protocol,
+    extract_protocol_facts,
+)
+from dlrover_tpu.analysis.state_roundtrip import StateRoundtripPass
 from dlrover_tpu.analysis.trace_safety import TraceSafetyPass
 
-BASELINE_VERSION = 1
+BASELINE_VERSION = 2
+CACHE_VERSION = 1
 
 
 @dataclasses.dataclass
@@ -36,6 +61,9 @@ class AnalysisResult:
     files_analyzed: int = 0
     parse_errors: List[str] = dataclasses.field(default_factory=list)
     analyzed_relpaths: List[str] = dataclasses.field(default_factory=list)
+    cache_hits: int = 0
+    cache_misses: int = 0
+    wall_time_s: float = 0.0
 
 
 def package_relpath(path: str) -> Optional[str]:
@@ -74,29 +102,112 @@ def iter_python_files(root: str) -> Iterable[Tuple[str, str]]:
                     path, root).replace(os.sep, "/")
 
 
-def analyze_file(path: str, relpath: str,
-                 source: Optional[str] = None) -> List[Finding]:
-    if source is None:
-        with open(path, encoding="utf-8") as f:
-            source = f.read()
+def _analyze_source(path: str, relpath: str,
+                    source: str) -> Tuple[List[Finding], Dict, Dict]:
+    """One file through every per-file pass + fact extractor. Returns
+    (post-pragma findings, cross-module facts, pragma map)."""
     lines = source.splitlines()
     if file_skipped(lines):
-        return []
+        return [], {}, {}
     tree = ast.parse(source, filename=path)
     findings: List[Finding] = []
     findings.extend(TraceSafetyPass().run(relpath, tree, lines))
     findings.extend(LockDisciplinePass().run(relpath, tree, lines))
-    return apply_pragmas(findings, lines)
+    findings.extend(StateRoundtripPass().run(relpath, tree, lines))
+    facts = extract_protocol_facts(relpath, tree, lines)
+    obs_facts = extract_obs_facts(relpath, tree, lines)
+    if obs_facts:
+        facts["obs"] = obs_facts
+    pragmas = {str(k): sorted(v)
+               for k, v in line_pragmas(lines).items()}
+    return apply_pragmas(findings, lines), facts, pragmas
+
+
+def analyze_file(path: str, relpath: str,
+                 source: Optional[str] = None) -> List[Finding]:
+    """Single-file entry point (fixture tests): per-file passes only."""
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    findings, _, _ = _analyze_source(path, relpath, source)
+    return findings
+
+
+# -- the per-file cache ------------------------------------------------------
+
+def _finding_to_dict(f: Finding) -> Dict:
+    return {"rule_id": f.rule_id, "path": f.path, "line": f.line,
+            "col": f.col, "message": f.message, "symbol": f.symbol}
+
+
+def _finding_from_dict(d: Dict) -> Finding:
+    return Finding(rule_id=d["rule_id"], path=d["path"],
+                   line=int(d["line"]), col=int(d["col"]),
+                   message=d["message"], symbol=d.get("symbol", ""))
+
+
+def load_cache(path: str) -> Dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict) or \
+            data.get("version") != CACHE_VERSION or \
+            data.get("rules") != rules_signature():
+        return {}        # rule logic changed: every result is stale
+    files = data.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def save_cache(path: str, files: Dict) -> None:
+    data = {"version": CACHE_VERSION, "rules": rules_signature(),
+            "files": files}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
+def _file_key(path: str) -> Optional[List[int]]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return [st.st_mtime_ns, st.st_size]
+
+
+# -- the whole-run driver ----------------------------------------------------
+
+def _doc_relpath(doc_path: str) -> str:
+    parts = os.path.abspath(doc_path).replace(os.sep, "/").split("/")
+    return "/".join(parts[-2:])
 
 
 def run_analysis(roots: Sequence[str],
-                 baseline: Optional[Dict] = None) -> AnalysisResult:
-    pairs: List[Tuple[Finding, str]] = []   # (finding, fingerprint)
-    fingerprints: Dict[str, str] = {}
+                 baseline: Optional[Dict] = None,
+                 cache_path: Optional[str] = None,
+                 obs_doc: Optional[str] = None) -> AnalysisResult:
+    started = time.monotonic()
+    per_path: Dict[str, List[Tuple[Finding, str]]] = {}
+    facts_by_path: Dict[str, Dict] = {}
+    pragmas_by_path: Dict[str, Dict[str, List[str]]] = {}
+    display_path: Dict[str, str] = {}   # unique fact key -> real relpath
     parse_errors: List[str] = []
     analyzed: List[str] = []
     seen_paths: set = set()
     files = 0
+    hits = misses = 0
+
+    cache = load_cache(cache_path) if cache_path else {}
+    cache_out: Dict = dict(cache)
+
     for root in roots:
         for path, relpath in iter_python_files(root):
             abspath = os.path.abspath(path)
@@ -104,35 +215,134 @@ def run_analysis(roots: Sequence[str],
                 continue      # overlapping roots: analyze each file once
             seen_paths.add(abspath)
             files += 1
-            try:
-                with open(path, encoding="utf-8") as f:
-                    source = f.read()
-                found = analyze_file(path, relpath, source)
-            except (SyntaxError, ValueError, UnicodeDecodeError,
-                    OSError) as e:
-                # SyntaxError from ast.parse; ValueError for NUL bytes;
-                # UnicodeDecodeError for non-UTF8 sources; OSError for
-                # unreadable files (dangling symlink, permissions). NOT
-                # recorded as analyzed: a file that failed to parse must
-                # keep its baseline entries (write_baseline drops entries
-                # only for successfully re-analyzed files)
-                parse_errors.append(f"{relpath}: {e}")
-                continue
+            key = _file_key(abspath)
+            entry = cache.get(abspath)
+            if (entry is not None and key is not None
+                    and entry.get("key") == key
+                    and entry.get("relpath") == relpath):
+                hits += 1
+                found = [(_finding_from_dict(d), d.get("srcline", ""))
+                         for d in entry.get("findings", [])]
+                facts = entry.get("facts") or {}
+                pragmas = entry.get("pragmas") or {}
+            else:
+                misses += 1
+                try:
+                    with open(abspath, encoding="utf-8") as f:
+                        source = f.read()
+                    findings, facts, pragmas = _analyze_source(
+                        abspath, relpath, source)
+                except (SyntaxError, ValueError, UnicodeDecodeError,
+                        OSError) as e:
+                    # SyntaxError from ast.parse; ValueError for NUL
+                    # bytes; UnicodeDecodeError for non-UTF8 sources;
+                    # OSError for unreadable files. NOT recorded as
+                    # analyzed: a file that failed to parse must keep
+                    # its baseline entries (write_baseline drops
+                    # entries only for re-analyzed files)
+                    parse_errors.append(f"{relpath}: {e}")
+                    cache_out.pop(abspath, None)
+                    continue
+                lines = source.splitlines()
+                found = [(fnd, source_line(lines, fnd.line))
+                         for fnd in findings]
+                if key is not None:
+                    cache_out[abspath] = {
+                        "key": key, "relpath": relpath,
+                        "findings": [
+                            dict(_finding_to_dict(fnd),
+                                 srcline=srcline)
+                            for fnd, srcline in found],
+                        "facts": facts,
+                        "pragmas": pragmas,
+                    }
             analyzed.append(relpath)
-            lines = source.splitlines()
-            # identical findings on textually identical lines (same rule,
-            # symbol, source text) get an occurrence suffix in line order:
-            # baselining the first must NOT suppress a second, newly-added
-            # copy of the same violation
-            found.sort(key=lambda f: (f.line, f.col, f.rule_id))
-            occurrence: Dict[str, int] = {}
-            for fnd in found:
-                base = fnd.fingerprint(source_line(lines, fnd.line))
-                n = occurrence.get(base, 0)
-                occurrence[base] = n + 1
-                fp = base if n == 0 else f"{base}#{n}"
-                fingerprints[fp] = f"{fnd.path}:{fnd.line} {fnd.rule_id}"
-                pairs.append((fnd, fp))
+            # distinct files can share a package-relative path when the
+            # analyzed roots span several packages (the real package +
+            # a fixture package): FACTS keep a unique key so the
+            # cross-module checkers never diff a chimera of two
+            # unrelated modules, while findings group by the REAL
+            # relpath — colliding files share one occurrence-suffix
+            # group, so textually identical findings still get
+            # distinct fingerprints
+            key_path = relpath
+            suffix = 2
+            while key_path in facts_by_path:
+                key_path = f"{relpath}#{suffix}"
+                suffix += 1
+            display_path[key_path] = relpath
+            facts_by_path[key_path] = facts
+            pragmas_by_path[key_path] = pragmas
+            per_path.setdefault(relpath, []).extend(found)
+
+    # -- cross-module checkers over the pooled facts ---------------------
+    cross: List[Tuple[Finding, str]] = list(
+        check_protocol(facts_by_path))
+    if obs_doc:
+        doc_rel = _doc_relpath(obs_doc)
+        try:
+            with open(obs_doc, encoding="utf-8") as f:
+                doc_text = f.read()
+        except OSError as e:
+            # a missing/unreadable catalog must FAIL the run, not
+            # silently disable GL601/602/603 — same discipline as a
+            # file that failed to parse
+            parse_errors.append(f"{doc_rel}: obs catalog unreadable "
+                                f"({e})")
+        else:
+            cross.extend(check_obs_catalog(doc_rel, doc_text,
+                                           facts_by_path))
+            # the doc WAS analyzed this run: write_baseline replaces
+            # entries for analyzed paths, so a fixed doc row's stale
+            # suppression drops out instead of surviving every
+            # regenerate
+            analyzed.append(doc_rel)
+    for fnd, srcline in cross:
+        pragmas = pragmas_by_path.get(fnd.path, {})
+        disabled = set(pragmas.get(str(fnd.line), ()))
+        if fnd.rule_id in disabled or "ALL" in disabled:
+            continue
+        # a cross-module finding carries the fact KEY as its path;
+        # translate back to the real relpath so reports and
+        # fingerprints never cite a phantom "path#2" file
+        real = display_path.get(fnd.path, fnd.path)
+        if real != fnd.path:
+            fnd = dataclasses.replace(fnd, path=real)
+        per_path.setdefault(real, []).append((fnd, srcline))
+
+    # -- fingerprints (occurrence-suffixed per file) ---------------------
+    pairs: List[Tuple[Finding, str]] = []   # (finding, fingerprint)
+    fingerprints: Dict[str, str] = {}
+    for relpath in sorted(per_path):
+        found = per_path[relpath]
+        # identical findings on textually identical lines (same rule,
+        # symbol, source text) get an occurrence suffix in line order:
+        # baselining the first must NOT suppress a second, newly-added
+        # copy of the same violation
+        found.sort(key=lambda pair: (pair[0].line, pair[0].col,
+                                     pair[0].rule_id))
+        occurrence: Dict[str, int] = {}
+        for fnd, srcline in found:
+            base = fnd.fingerprint(srcline)
+            n = occurrence.get(base, 0)
+            occurrence[base] = n + 1
+            fp = base if n == 0 else f"{base}#{n}"
+            fingerprints[fp] = f"{fnd.path}:{fnd.line} {fnd.rule_id}"
+            pairs.append((fnd, fp))
+
+    if cache_path:
+        # prune entries for files that are gone (deleted/renamed):
+        # without this the cache would grow unboundedly with dead
+        # findings/facts payloads. A prune counts as a change worth
+        # persisting even on an otherwise all-hit run.
+        pruned = 0
+        for stale in list(cache_out):
+            if stale not in seen_paths and not os.path.exists(stale):
+                del cache_out[stale]
+                pruned += 1
+        if misses or pruned:
+            save_cache(cache_path, cache_out)
+
     suppressed = set((baseline or {}).get("suppressions", []))
     new = [fnd for fnd, fp in pairs if fp not in suppressed]
     return AnalysisResult(
@@ -142,6 +352,9 @@ def run_analysis(roots: Sequence[str],
         files_analyzed=files,
         parse_errors=parse_errors,
         analyzed_relpaths=analyzed,
+        cache_hits=hits,
+        cache_misses=misses,
+        wall_time_s=time.monotonic() - started,
     )
 
 
@@ -186,6 +399,7 @@ def write_baseline(path: str, result: AnalysisResult) -> None:
         notes[fp] = note
     data = {
         "version": BASELINE_VERSION,
+        "rules": rules_signature(),
         "comment": (
             "accepted pre-existing graftlint findings; regenerate with "
             "`python tools/graftlint.py --write-baseline <roots>` after "

@@ -1,0 +1,381 @@
+"""Pass 3: state-roundtrip analysis of state-backend participants.
+
+Every class that participates in the crash-consistent master state
+(PR 3's ``MasterStateBackend``: it defines ``export_state``/
+``restore_state`` or the ``_export_extra``/``_restore_extra`` extension
+hooks) makes an implicit promise: a master failover rebuilds it from the
+snapshot with nothing lost. Review rounds of PRs 3–11 kept re-finding
+the same two breaches by hand, so this pass proves them mechanically:
+
+GL301  a mutable instance attribute (assigned in ``__init__`` or under
+       the class's lock) that the export/restore pair never touches and
+       that is not annotated ``# graftlint: ephemeral(reason)`` —
+       silently reset on failover (PR 9's ``_known_chips``).
+GL302  an asymmetric snapshot key: export emits a key restore never
+       consumes (dead weight, or a restore that silently defaults —
+       PR 3's "silently-empty worlds"), or restore reads a key export
+       never emits (the default is all it will ever see).
+
+Class families merge same-module bases (``group_class_families``), so
+a base's ``export_state`` covering ``self._x`` through a subclass's
+``_export_extra`` is one analysis unit. Coverage is transitive through
+``self.method()`` calls reachable from the export/restore roots — a
+helper the exporter delegates to covers its attributes.
+
+Key extraction is deliberately conservative: GL302 only compares sides
+whose keys are FULLY extractable (a top-level dict literal return /
+``state["k"] = …`` writes on the export side; ``state["k"]`` /
+``state.get("k")`` / ``state.pop("k")`` reads on the restore side). An
+export built by comprehension, or a restore that iterates the whole
+dict, makes that side unknown and the symmetry check stands down rather
+than guess. The key literally named ``"version"`` is exempt — a format
+stamp the restore side may legitimately ignore.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dlrover_tpu.analysis.findings import Finding, ephemeral_lines
+from dlrover_tpu.analysis.lock_discipline import (
+    _LOCK_FACTORIES,
+    group_class_families,
+)
+from dlrover_tpu.analysis.trace_safety import _dotted_name, _import_aliases
+
+EXPORT_METHODS = ("export_state", "_export_extra")
+RESTORE_METHODS = ("restore_state", "_restore_extra")
+_INIT_METHODS = {"__init__", "__post_init__"}
+# container constructors whose product is mutable state worth a snapshot
+_MUTABLE_CALLS = {
+    "dict", "list", "set", "bytearray",
+    "collections.deque", "deque",
+    "collections.defaultdict", "defaultdict",
+    "collections.OrderedDict", "OrderedDict",
+    "collections.Counter", "Counter",
+}
+# a format-stamp key the restore side may legitimately never read
+_VERSION_KEY = "version"
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")):
+        return node.attr
+    return None
+
+
+class _Family:
+    """One class + its same-module bases, viewed for state analysis."""
+
+    def __init__(self, name: str, classes: List[ast.ClassDef],
+                 aliases: Dict[str, str]):
+        self.name = name
+        self.aliases = aliases
+        self.methods: Dict[str, List[ast.FunctionDef]] = {}
+        for cls in classes:
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self.methods.setdefault(item.name, []).append(item)
+        self.lock_attrs: Set[str] = set()
+        for fns in self.methods.values():
+            for fn in fns:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and isinstance(
+                            node.value, ast.Call):
+                        head = _dotted_name(node.value.func, aliases)
+                        if head in _LOCK_FACTORIES:
+                            for tgt in node.targets:
+                                attr = _is_self_attr(tgt)
+                                if attr:
+                                    self.lock_attrs.add(attr)
+
+    def participates(self) -> bool:
+        return any(m in self.methods
+                   for m in EXPORT_METHODS + RESTORE_METHODS)
+
+    def roundtrip_reachable(self) -> Set[str]:
+        """Method names reachable from the export/restore roots via
+        ``self.method()`` calls (the exporter's helpers cover state)."""
+        seen: Set[str] = set()
+        work = [m for m in EXPORT_METHODS + RESTORE_METHODS
+                if m in self.methods]
+        while work:
+            name = work.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for fn in self.methods.get(name, ()):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and isinstance(
+                            node.func, ast.Attribute):
+                        callee = _is_self_attr(node.func)
+                        if callee and callee in self.methods:
+                            work.append(callee)
+        return seen
+
+
+def _walk_own(fn: ast.FunctionDef):
+    """ast.walk limited to the function's OWN body: nested defs/lambdas
+    (task_entry-style helpers building NESTED payload dicts) are not
+    part of the snapshot's top-level key vocabulary."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _mutable_value(expr: ast.AST, aliases: Dict[str, str]) -> bool:
+    if isinstance(expr, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                         ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call):
+        head = _dotted_name(expr.func, aliases)
+        return head in _MUTABLE_CALLS
+    return False
+
+
+class StateRoundtripPass:
+    def run(self, relpath: str, tree: ast.Module,
+            source_lines: Sequence[str]) -> List[Finding]:
+        aliases = _import_aliases(tree)
+        ephemeral = ephemeral_lines(source_lines)
+        findings: List[Finding] = []
+        classes = [n for n in tree.body if isinstance(n, ast.ClassDef)]
+        for root, members in group_class_families(classes):
+            family = _Family(root, members, aliases)
+            if not family.participates():
+                continue
+            findings.extend(self._check_coverage(
+                relpath, family, ephemeral))
+            findings.extend(self._check_key_symmetry(relpath, family))
+        return findings
+
+    # -- GL301 -------------------------------------------------------------
+    def _check_coverage(self, relpath: str, family: _Family,
+                        ephemeral: Dict[int, str]) -> List[Finding]:
+        reachable = family.roundtrip_reachable()
+
+        # attribute writes, split by where they happen
+        init_assigns: Dict[str, Tuple[int, int, ast.AST]] = {}
+        other_writes: Dict[str, Tuple[int, int]] = {}
+        locked_writes: Set[str] = set()
+        write_lines: Dict[str, List[int]] = {}
+        covered: Set[str] = set()
+
+        def scan_method(name: str, fn: ast.FunctionDef) -> None:
+            lock_depth = 0
+
+            def visit(node: ast.AST) -> None:
+                nonlocal lock_depth
+                pushed = 0
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        expr = item.context_expr
+                        attr = _is_self_attr(expr)
+                        if attr and attr in family.lock_attrs:
+                            lock_depth += 1
+                            pushed += 1
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                lock_depth -= pushed
+                attr = _is_self_attr(node)
+                if attr is None or attr in family.lock_attrs:
+                    return
+                if name in reachable:
+                    covered.add(attr)
+                    return
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    write_lines.setdefault(attr, []).append(node.lineno)
+                    if name in _INIT_METHODS:
+                        init_assigns.setdefault(
+                            attr, (node.lineno, node.col_offset, node))
+                    else:
+                        other_writes.setdefault(
+                            attr, (node.lineno, node.col_offset))
+                        if lock_depth > 0:
+                            locked_writes.add(attr)
+
+            visit(fn)
+
+        for name, fns in family.methods.items():
+            for fn in fns:
+                scan_method(name, fn)
+
+        # mutability of the __init__-assigned value (per assignment
+        # statement: `self.x = {}` → the Assign's value)
+        mutable_init: Set[str] = set()
+        for name in _INIT_METHODS:
+            for fn in family.methods.get(name, ()):
+                for node in ast.walk(fn):
+                    # both assignment styles: `self.x = {}` AND the
+                    # annotated `self.x: Dict[str, int] = {}` — the
+                    # dominant style in this codebase
+                    if isinstance(node, ast.Assign):
+                        targets = node.targets
+                        value = node.value
+                    elif isinstance(node, ast.AnnAssign) and \
+                            node.value is not None:
+                        targets = [node.target]
+                        value = node.value
+                    else:
+                        continue
+                    if _mutable_value(value, family.aliases):
+                        for tgt in targets:
+                            attr = _is_self_attr(tgt)
+                            if attr:
+                                mutable_init.add(attr)
+
+        candidates: Set[str] = set()
+        for attr in init_assigns:
+            if attr in mutable_init or attr in other_writes:
+                candidates.add(attr)
+        candidates |= locked_writes
+        candidates -= family.lock_attrs
+
+        findings: List[Finding] = []
+        for attr in sorted(candidates):
+            if attr in covered:
+                continue
+            # the annotation sits on the assignment line or the line
+            # directly above it (79-col style: the reason rarely fits
+            # beside the assignment)
+            if any(line in ephemeral or line - 1 in ephemeral
+                   for line in write_lines.get(attr, ())):
+                continue
+            line, col, _ = init_assigns.get(
+                attr, other_writes.get(attr, (0, 0)) + (None,))
+            findings.append(Finding(
+                "GL301", relpath, line, col,
+                f"'{family.name}.{attr}' is mutable state outside the "
+                f"export/restore roundtrip (not exported, not restored, "
+                f"not annotated `# graftlint: ephemeral(reason)`) — a "
+                f"failover silently resets it",
+                symbol=f"{family.name}.{attr}"))
+        return findings
+
+    # -- GL302 -------------------------------------------------------------
+    def _check_key_symmetry(self, relpath: str,
+                            family: _Family) -> List[Finding]:
+        exported: Dict[str, Tuple[int, int]] = {}
+        consumed: Dict[str, Tuple[int, int]] = {}
+        export_opaque = False
+        restore_opaque = False
+
+        def state_param(fn: ast.FunctionDef) -> Optional[str]:
+            params = [a.arg for a in fn.args.args if a.arg not in
+                      ("self", "cls")]
+            return params[0] if params else None
+
+        for name in EXPORT_METHODS:
+            for fn in family.methods.get(name, ()):
+                param = state_param(fn)
+                for node in _walk_own(fn):
+                    if isinstance(node, ast.Return) and \
+                            node.value is not None:
+                        if isinstance(node.value, ast.Dict):
+                            for key in node.value.keys:
+                                if isinstance(key, ast.Constant) and \
+                                        isinstance(key.value, str):
+                                    exported.setdefault(
+                                        key.value,
+                                        (node.lineno, node.col_offset))
+                                else:
+                                    export_opaque = True  # **spread
+                        elif not isinstance(node.value, ast.Constant):
+                            export_opaque = True
+                    elif (isinstance(node, ast.Assign)
+                          and param is not None):
+                        for tgt in node.targets:
+                            if (isinstance(tgt, ast.Subscript)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == param):
+                                sl = tgt.slice
+                                if isinstance(sl, ast.Constant) and \
+                                        isinstance(sl.value, str):
+                                    exported.setdefault(
+                                        sl.value,
+                                        (node.lineno, node.col_offset))
+                                else:
+                                    export_opaque = True
+
+        for name in RESTORE_METHODS:
+            for fn in family.methods.get(name, ()):
+                param = state_param(fn)
+                if param is None:
+                    continue
+                for node in _walk_own(fn):
+                    # state["k"] reads
+                    if (isinstance(node, ast.Subscript)
+                            and isinstance(node.value, ast.Name)
+                            and node.value.id == param
+                            and isinstance(node.ctx, ast.Load)):
+                        sl = node.slice
+                        if isinstance(sl, ast.Constant) and \
+                                isinstance(sl.value, str):
+                            consumed.setdefault(
+                                sl.value, (node.lineno, node.col_offset))
+                        else:
+                            restore_opaque = True
+                    # state.get("k")/state.pop("k"); state.items()/
+                    # .keys()/.values() or `for k in state` → opaque
+                    elif isinstance(node, ast.Call) and isinstance(
+                            node.func, ast.Attribute) and isinstance(
+                            node.func.value, ast.Name) and \
+                            node.func.value.id == param:
+                        if node.func.attr in ("get", "pop") and \
+                                node.args and isinstance(
+                                node.args[0], ast.Constant) and \
+                                isinstance(node.args[0].value, str):
+                            consumed.setdefault(
+                                node.args[0].value,
+                                (node.lineno, node.col_offset))
+                        else:
+                            restore_opaque = True
+                    elif isinstance(node, ast.For) and isinstance(
+                            node.iter, ast.Name) and \
+                            node.iter.id == param:
+                        restore_opaque = True
+                    # the whole dict handed to something else (a helper,
+                    # json.dumps, dict(state)): its reads are invisible
+                    elif isinstance(node, ast.Call):
+                        for arg in list(node.args) + [
+                                kw.value for kw in node.keywords]:
+                            if isinstance(arg, ast.Name) and \
+                                    arg.id == param:
+                                callee = node.func
+                                if not (isinstance(callee, ast.Attribute)
+                                        and isinstance(callee.value,
+                                                       ast.Name)
+                                        and callee.value.id == param):
+                                    restore_opaque = True
+
+        findings: List[Finding] = []
+        if exported and consumed:
+            if not restore_opaque:
+                for key in sorted(set(exported) - set(consumed)):
+                    if key == _VERSION_KEY:
+                        continue
+                    line, col = exported[key]
+                    findings.append(Finding(
+                        "GL302", relpath, line, col,
+                        f"{family.name} exports snapshot key "
+                        f"'{key}' that restore never consumes",
+                        symbol=f"{family.name}.{key}"))
+            if not export_opaque:
+                for key in sorted(set(consumed) - set(exported)):
+                    line, col = consumed[key]
+                    findings.append(Finding(
+                        "GL302", relpath, line, col,
+                        f"{family.name} restores snapshot key "
+                        f"'{key}' that export never emits (the reader "
+                        f"only ever sees the default)",
+                        symbol=f"{family.name}.{key}"))
+        return findings

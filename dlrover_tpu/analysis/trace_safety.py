@@ -462,11 +462,12 @@ class TraceSafetyPass:
                     f"`os.environ[...]` read inside traced '{qual}'",
                     symbol=qual))
             elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                kw = ("global" if isinstance(node, ast.Global)
+                      else "nonlocal")
                 findings.append(Finding(
                     "GL103", self.relpath, node.lineno, node.col_offset,
-                    f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
-                    f" {', '.join(node.names)}` inside traced '{qual}'",
-                    symbol=qual))
+                    f"`{kw} {', '.join(node.names)}` inside traced "
+                    f"'{qual}'", symbol=qual))
             elif isinstance(node, (ast.Assign, ast.AugAssign)):
                 targets = (node.targets if isinstance(node, ast.Assign)
                            else [node.target])

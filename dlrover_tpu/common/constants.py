@@ -438,3 +438,12 @@ class DefaultValues:
 # only one side would silently route hot traffic to the control tier or
 # skip snapshotting a cold key.
 HOT_KV_PREFIXES = ("dcn/", "coord/")
+
+# The durable subset of the hot prefixes: coord/ barrier mutations ride
+# the mutation log (a promoted master must answer the coordinator
+# addresses agents kv_wait on), dcn/ payloads are per-step ephemeral by
+# protocol and never logged. Lives HERE beside HOT_KV_PREFIXES — the
+# same single-sourcing contract (graftlint GL403): a prefix split
+# between kv_store and a future standby replay path would silently
+# diverge durability.
+LOGGED_KV_PREFIXES = ("coord/",)

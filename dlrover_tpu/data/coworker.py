@@ -55,7 +55,10 @@ class CoworkerDataService:
         request = msg.deserialize_message(payload)
         if isinstance(request, msg.CoworkerBatchRequest):
             q = self._queue_for(request.dataset_name)
-            return msg.serialize_message(msg.CoworkerInfo(
+            # queued/capacity are the data_info back-off contract:
+            # consumed by out-of-repo coworker runners pacing their
+            # push loops, not by anything in this package
+            return msg.serialize_message(msg.CoworkerInfo(  # graftlint: disable=GL401
                 dataset_name=request.dataset_name,
                 queued=q.qsize(), capacity=self._capacity,
                 finished=self._finished,
@@ -120,12 +123,17 @@ class CoworkerClient:
 
     def push_batch(self, batch: Any, dataset_name: str = "default") -> bool:
         self._seq += 1
-        raw = self._stub.report(msg.serialize_message(msg.CoworkerBatch(
+        # producer_id/seq stamp the wire for duplicate/ordering
+        # forensics on multi-producer setups; the service consumes
+        # payload only by design (queue order is the contract)
+        record = msg.CoworkerBatch(  # graftlint: disable=GL401
             dataset_name=dataset_name,
             payload=pickle.dumps(batch,
                                  protocol=pickle.HIGHEST_PROTOCOL),
             producer_id=self._producer_id,
             seq=self._seq,
-        )), timeout=self._timeout_s)
+        )
+        raw = self._stub.report(msg.serialize_message(record),
+                                timeout=self._timeout_s)
         response = msg.deserialize_message(raw)
         return bool(getattr(response, "success", False))

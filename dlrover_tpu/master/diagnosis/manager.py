@@ -68,12 +68,15 @@ class DiagnosisManager:
         self._lock = threading.Lock()
         self._diag_lock = threading.Lock()
         self._reports: deque = deque(maxlen=_REPORT_RING)
+        # graftlint: ephemeral(evidence; re-accumulates from the next resource reports)
         self._node_stats: Dict[int, Dict[str, Any]] = {}
         self._pending: Dict[int, deque] = {}
         self._last_action_ts: Dict[int, float] = {}
         self._next_action_id = 1
+        # graftlint: ephemeral(published-gauge dedup; republished on the next round)
         self._published_scores: set = set()
         self._stopped = threading.Event()
+        # graftlint: ephemeral(loop thread handle; start() spawns a fresh one)
         self._thread: Optional[threading.Thread] = None
         # crash-consistency hook (JobMaster wires _maybe_snapshot): new
         # reports should survive a master restart
@@ -96,6 +99,7 @@ class DiagnosisManager:
         # per-worker gauges carry the rank's slice (multi-slice
         # hierarchical DP; "-1" on single-slice jobs) so dashboards can
         # group by failure domain and a departing SLICE evicts as a unit
+        # graftlint: ephemeral(re-pushed at JobMaster._restore_state)
         self._slice_map: Dict[int, int] = {}
         self._score_gauge = registry.gauge(
             "dlrover_tpu_worker_straggler_score",

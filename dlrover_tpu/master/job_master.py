@@ -315,6 +315,14 @@ class JobMaster:
             mgr = self.rdzv_managers.get(name)
             if mgr is not None:
                 mgr.restore_state(rdzv_state)
+        # re-fan the restored rank→slice registry to every slice-labeled
+        # consumer NOW (speed monitor, diagnosis, goodput): joins are the
+        # only other push site, and reconnecting agents whose worlds are
+        # intact never re-join — without this, per-slice gauges and
+        # eviction-by-slice would mislabel until the next real join
+        training = self.rdzv_managers.get(RendezvousName.TRAINING)
+        if training is not None and training.slice_map:
+            self.servicer._push_slice_map(training)
         self.task_manager.restore_state(state.get("task_manager", {}))
         self.kv_store.restore_state(state.get("kv_store", {}))
         self.speed_monitor.restore_state(state.get("speed_monitor", {}))
@@ -364,6 +372,13 @@ class JobMaster:
                     self._snapshot_timer = timer
                     timer.start()
                 return
+            # sample the mutation-log fence BEFORE exporting: every
+            # mutation the export can contain already holds a smaller
+            # seq (appends ride the same kv lock), so rotation keeps
+            # anything newer — a hot set landing between export and
+            # rotate stays durable in the rewritten log
+            fence = (self._mutation_log.current_seq()
+                     if self._mutation_log is not None else 0)
             try:
                 written = self._state_backend.save_if_changed(
                     self._export_state())
@@ -373,9 +388,9 @@ class JobMaster:
             if written is not None:
                 self._last_snapshot_ts = time.time()
                 if self._mutation_log is not None:
-                    # the snapshot's kv export includes the hot keys at
-                    # this instant: every logged mutation is now durable
-                    self._mutation_log.rotate()
+                    # the snapshot's kv export includes every hot
+                    # mutation below the fence: those are durable now
+                    self._mutation_log.rotate(up_to_seq=fence)
 
     def _trailing_snapshot(self) -> None:
         """Timer body: flush the mutation that fell inside the

@@ -46,6 +46,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import HOT_KV_PREFIXES as HOT_PREFIXES
+from dlrover_tpu.common.constants import LOGGED_KV_PREFIXES
 
 # Hot keys worth DURABILITY: the coord/ barrier keys (coordinator
 # addresses agents kv_wait on — a promoted master must answer them or
@@ -54,8 +55,9 @@ from dlrover_tpu.common.constants import HOT_KV_PREFIXES as HOT_PREFIXES
 # overwrites them, readers treat absence as absence by protocol) and
 # large (a grad payload per slice per step) — logging them would put a
 # multi-MB disk write on the gradient path and grow the log unbounded
-# between snapshots.
-LOGGED_PREFIXES = ("coord/",)
+# between snapshots. Single-sourced in common/constants.py next to
+# HOT_KV_PREFIXES (graftlint GL403).
+LOGGED_PREFIXES = LOGGED_KV_PREFIXES
 
 # Generation-namespaced key shapes → (group, generation). The GROUP is the
 # key with its generation component removed; within one group only the
@@ -92,9 +94,11 @@ class KVStoreService:
         # episode plus one for in-flight readers of the one it replaced)
         self._keep_generations = max(1, keep_generations)
         self._generations: Dict[str, Dict[int, List[str]]] = {}
+        # graftlint: ephemeral(gc tally; the registry counter is the durable surface)
         self.collected_total = 0
         # hot-key durability: appended per mutation instead of
         # triggering a snapshot (state_backend.MutationLog; None = off)
+        # graftlint: ephemeral(re-attached by the restarting master's wiring)
         self._mutation_log = None
 
     # -- hot-key plumbing ------------------------------------------------

@@ -11,6 +11,7 @@ detects hang from heartbeats + the speed monitor.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Dict, List, Optional
@@ -58,7 +59,9 @@ class JobManager:
         self._stopped = threading.Event()
         self._stage = JobStage.CREATED
         self._exit_reason = ""
+        # graftlint: ephemeral(wiring; JobMaster re-registers callbacks at start)
         self._event_callbacks: List[NodeEventCallback] = []
+        # graftlint: ephemeral(thread handles; start() spawns fresh ones)
         self._threads: List[threading.Thread] = []
         self._relaunch_always = job_args.relaunch_always
         self._model_info: Optional[msg.ModelInfo] = None
@@ -361,7 +364,9 @@ class JobManager:
         self._scaler.scale(plan)
 
     def collect_model_info(self, info: msg.ModelInfo) -> None:
-        self._model_info = info
+        with self._lock:
+            # export_state snapshots this under the same lock
+            self._model_info = info
 
     # -- crash-consistent state (master/state_backend.py) ---------------
     def export_state(self) -> dict:
@@ -374,6 +379,12 @@ class JobManager:
                                 for nid, node in by_id.items()}
                     for node_type, by_id in self._nodes.items()
                 },
+                # the resource optimizer's model profile: workers report
+                # ModelInfo once at loop build — a failover that lost it
+                # would leave the optimizer profile-blind until the next
+                # full worker restart (graftlint GL301)
+                "model_info": (dataclasses.asdict(self._model_info)
+                               if self._model_info else None),
             }
 
     def restore_state(self, state: dict) -> None:
@@ -389,6 +400,13 @@ class JobManager:
                     int(nid): Node.from_dict(d)
                     for nid, d in by_id.items()
                 }
+            info = state.get("model_info")
+            if isinstance(info, dict):
+                # filter to known fields: a snapshot written by a newer
+                # master must not crash an older one's restore
+                known = {f.name for f in dataclasses.fields(msg.ModelInfo)}
+                self._model_info = msg.ModelInfo(
+                    **{k: v for k, v in info.items() if k in known})
 
     # -- hang detection -------------------------------------------------
     def all_running_node_hanged(self) -> bool:

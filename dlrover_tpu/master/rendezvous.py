@@ -128,6 +128,7 @@ class RendezvousManager:
     slice_scoped = True
 
     def __init__(self, params: Optional[RendezvousParameters] = None):
+        # graftlint: ephemeral(re-derived via update_rdzv_params)
         self._params = params or RendezvousParameters()
         self._lock = threading.Lock()
         self._waiting: Dict[int, _WaitingNode] = {}
@@ -151,6 +152,7 @@ class RendezvousManager:
         # round cuts, membership changes — NOT liveness touches): lets
         # the servicer skip the full state export+hash on the
         # steady-state polls, which mutate nothing almost always
+        # graftlint: ephemeral(dirty counter; the new incarnation restarts at 0)
         self._mutations = 0
         # rank -> departure deadline (unix ts): ranks that announced a
         # preemption drain. Still alive (training until departure), but
@@ -191,6 +193,7 @@ class RendezvousManager:
         # exported — the calibration itself persists and re-pushes
         # after a restore, so the discounts can never outlive their
         # evidence.
+        # graftlint: ephemeral(re-pushed via push_axis_discounts)
         self._axis_discounts: Dict[str, float] = {}
         # rank -> chips, remembered across world invalidations: the
         # planner must see the EXPECTED post-re-formation world at the

@@ -228,6 +228,7 @@ class ShardedRendezvousManager:
         self._slices: Dict[int, int] = {}
         self._shards: Dict[int, RendezvousShard] = {
             FLEET_SHARD: RendezvousShard(FLEET_SHARD, self._params)}
+        # graftlint: ephemeral(dirty counter; the new incarnation restarts at 0)
         self._mutations = 0
         # the fleet-wide membership-loss clock: router base + the sum of
         # per-shard epochs (any shard's loss moves the fleet epoch)
@@ -240,6 +241,7 @@ class ShardedRendezvousManager:
         self._chip_hbm_bytes = 0
         self._last_plan: Optional[Dict] = None
         self._last_plan_inputs: Optional[Tuple] = None
+        # graftlint: ephemeral(re-pushed via push_axis_discounts)
         self._axis_discounts: Dict[str, float] = {}
 
     # -- routing ----------------------------------------------------------

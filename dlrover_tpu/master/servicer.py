@@ -336,6 +336,13 @@ class MasterServicer:
         if isinstance(request, msg.DatasetShardParams):
             self.task_manager.new_dataset(request)
         elif isinstance(request, msg.TaskResult):
+            if not request.success and request.err_message:
+                # the worker's failure detail must not die in the RPC:
+                # recover_tasks requeues silently otherwise
+                logger.warning("task %d of %s failed on worker %d: %s",
+                               request.task_id, request.dataset_name,
+                               request.worker_id,
+                               request.err_message[:256])
             ok = self.task_manager.report_dataset_task(
                 request.dataset_name, request.task_id, request.success
             )
@@ -530,6 +537,12 @@ class MasterServicer:
             else:
                 ok, reason = False, "no job manager"
         elif isinstance(request, msg.ModelInfo):
+            logger.info(
+                "model info: %.3gB params, flops/token=%.3g (%s), "
+                "batch=%d seq=%d chips=%d",
+                request.param_count / 1e9, request.flops_per_token,
+                request.flops_source or "analytic",
+                request.batch_size, request.seq_len, request.chips)
             if self.job_manager is not None:
                 self.job_manager.collect_model_info(request)
             if self.metric_collector is not None:

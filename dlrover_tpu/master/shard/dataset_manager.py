@@ -79,12 +79,14 @@ class BatchDatasetManager:
         self.doing: Dict[int, DoingTask] = {}
         self._task_id_seq = 0
         self._completed_records = 0
+        # graftlint: ephemeral(timeout heuristic; re-learned from completions)
         self._max_task_completed_time = 0.0
         # bumped on every mutation of snapshotted state — including
         # splitter epoch advances that yield NO task (a huge dataset's
         # final sub-epoch flip must reach a snapshot even though the
         # worker only got a WAIT/NONE answer). Gated on by the servicer
         # so idle WAIT polls don't pay for a state export.
+        # graftlint: ephemeral(dirty counter; the new incarnation restarts at 0)
         self.mutation_count = 0
 
     @property
@@ -253,6 +255,10 @@ class BatchDatasetManager:
                 epoch=int(entry.get("epoch", 0)),
             )
 
+        # the exported task_type wins over the constructor's: a dataset
+        # re-registered (new_dataset) before the snapshot restored must
+        # not flip restored tasks back to the registration default
+        self._task_type = str(state.get("task_type", self._task_type))
         self._task_id_seq = int(state.get("task_id_seq", 0))
         self._completed_records = int(state.get("completed_records", 0))
         self._splitter.epoch = int(state.get("epoch", 0))

@@ -50,9 +50,12 @@ class SpeedMonitor:
             maxlen=ctx.speed_sample_window
         )
         self._global_step = 0
+        # graftlint: ephemeral(this incarnation's clock anchor)
         self._first_step_time: Optional[float] = None
         self._last_step_time: float = time.time()
+        # graftlint: ephemeral(re-learned from the next step reports)
         self._workers: Set[int] = set()
+        # graftlint: ephemeral(re-learned from the next step reports)
         self._worker_steps: Dict[int, int] = {}
         # worker_id -> deque[(step_time_s, data_wait_fraction, mfu, ts)]
         # from step reports that carried timing evidence
@@ -62,6 +65,7 @@ class SpeedMonitor:
         # steps/s high-water mark over the job (throughput-collapse
         # baseline; survives window resets, cleared on restore)
         self._peak_speed = 0.0
+        # graftlint: ephemeral(wall-clock anchor of THIS incarnation)
         self._start_training_time: Optional[float] = None
         self._paused_time_s: float = 0.0
         self._tokens_per_step: int = 0
@@ -80,7 +84,9 @@ class SpeedMonitor:
         # multi-slice hierarchical DP: rank → slice (from the rendezvous
         # slice registry) + the slice label-pairs currently published,
         # so a departing slice's series evict as a unit
+        # graftlint: ephemeral(re-pushed at JobMaster._restore_state)
         self._slice_map: Dict[int, int] = {}
+        # graftlint: ephemeral(gauge dedup; republished next tick)
         self._published_slices: Set[str] = set()
         self._publish_metrics()
 

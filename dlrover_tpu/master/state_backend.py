@@ -82,10 +82,28 @@ class MutationLog:
         self._path = os.path.join(directory, MUTATION_LOG_NAME)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        # the open handle is OWNED by the drainer thread: every
+        # write/flush happens outside the lock (kv mutations enqueue
+        # under the kv condition — a disk stall here would be a
+        # per-step stall, the PR 10 lesson GL501 now enforces).
+        # rotate()/close() take the handle out under the lock after
+        # quiescing the drainer and do their file work unlocked.
         self._file = None
         self._seq = 0
-        self._queue: List[str] = []
+        self._queue: List[Tuple[int, str]] = []   # (seq, json line)
         self._in_flight = 0
+        self._rotating = False
+        # bumped at every rotation start: a drainer batch that raced a
+        # rotation (quiesce timeout) detects the epoch change and
+        # re-writes itself to the FRESH file instead of silently
+        # landing on the replaced inode
+        self._rotations = 0
+        # the seq fence of the last rotation: entries below it are
+        # covered by the snapshot that triggered the rotation and must
+        # NOT be re-enqueued (a resurrected pre-snapshot value would
+        # regress the key on last-wins replay); entries at/after it
+        # are post-export and must SURVIVE the rotation
+        self._rotate_cutoff = 0
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
         self.gate = None
@@ -96,20 +114,28 @@ class MutationLog:
         with self._lock:
             return self._path
 
+    def current_seq(self) -> int:
+        """The next seq to be assigned — the caller samples it BEFORE a
+        state export as the rotation fence: every mutation the export
+        can contain was appended (same kv lock) with a smaller seq,
+        and anything the export might miss gets a larger one."""
+        with self._cond:
+            return self._seq
+
     def append(self, key: str, value: bytes) -> None:
         """Enqueue the RESULTING value of a mutation (b"" = the key was
         deleted); the drainer writes it. Cheap by design: callers hold
-        the kv store's condition lock."""
-        line = json.dumps({
-            "seq": self._seq,
-            "k": key,
-            "v": base64.b64encode(value).decode("ascii"),
-        })
+        the kv store's condition lock. The payload encoding happens
+        OUTSIDE this log's lock (only the seq stamp needs it) so a
+        large value never extends the critical section."""
+        encoded = base64.b64encode(value).decode("ascii")
         with self._cond:
             if self._stopped:
                 return
+            seq = self._seq
             self._seq += 1
-            self._queue.append(line)
+            line = json.dumps({"seq": seq, "k": key, "v": encoded})
+            self._queue.append((seq, line))
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._drain, daemon=True,
@@ -120,31 +146,68 @@ class MutationLog:
     def _drain(self) -> None:
         while True:
             with self._cond:
-                while not self._queue and not self._stopped:
+                while self._rotating or (
+                        not self._queue and not self._stopped):
+                    if self._stopped and not self._queue:
+                        return
                     self._cond.wait()
                 if self._stopped and not self._queue:
                     return
                 batch = self._queue
                 self._queue = []
                 self._in_flight = len(batch)
+                handle = self._file
+                epoch = self._rotations
             gate = self.gate
+            discarded = False
             try:
                 if gate is not None and gate():
                     # fenced: a higher-generation master owns this
                     # lineage — drop instead of corrupting its log
+                    discarded = True
                     continue
-                with self._lock:
-                    if self._file is None:
-                        self._file = open(self._path, "a")
-                    self._file.write("\n".join(batch) + "\n")
-                    self._file.flush()
-            except OSError as e:
+                # file work OUTSIDE the lock: the handle is drainer-
+                # owned between rotations (rotate/close quiesce on
+                # _in_flight before touching it), so an append caller
+                # holding the kv condition never waits on the disk
+                if handle is None:
+                    handle = open(self._path, "a")
+                    with self._cond:
+                        if self._rotations == epoch and \
+                                not self._rotating:
+                            self._file = handle
+                handle.write(
+                    "\n".join(line for _, line in batch) + "\n")
+                handle.flush()
+            except (OSError, ValueError) as e:
+                # ValueError: write on a handle rotate closed in the
+                # quiesce-timeout corner (the epoch re-check below
+                # re-writes the batch to the fresh file)
                 logger.warning("mutation log append failed: %s", e)
             except Exception:  # noqa: BLE001 — a broken gate must not
                 # kill the writer
                 logger.exception("mutation log gate failed")
             finally:
                 with self._cond:
+                    if not discarded and epoch != self._rotations:
+                        # a rotation raced this batch past its quiesce
+                        # timeout: the bytes may sit on the replaced
+                        # inode. Drop the (possibly stale) handle and
+                        # re-enqueue ONLY the post-fence entries for
+                        # the fresh file — pre-fence ones are covered
+                        # by the snapshot that rotated, and re-writing
+                        # them could resurrect a superseded value over
+                        # the snapshot's newer one on replay.
+                        if handle is not None:
+                            try:
+                                handle.close()
+                            except OSError:
+                                pass
+                        if self._file is handle:
+                            self._file = None
+                        keep = [entry for entry in batch
+                                if entry[0] >= self._rotate_cutoff]
+                        self._queue = keep + self._queue
                     self._in_flight = 0
                     self._cond.notify_all()
 
@@ -162,21 +225,65 @@ class MutationLog:
                 self._cond.wait(remaining)
         return True
 
-    def rotate(self) -> None:
-        """Truncate after a snapshot write: every logged mutation is now
-        part of (or older than) the durable snapshot."""
+    def rotate(self, up_to_seq: Optional[int] = None) -> None:
+        """Drop entries the snapshot just made durable. ``up_to_seq``
+        is the fence the caller sampled via :meth:`current_seq` BEFORE
+        exporting state: entries below it are in (or older than) the
+        snapshot and go; entries at/after it may have landed between
+        the export and this call — they are in NEITHER the snapshot
+        nor (after a naive truncate) the log, so they are preserved in
+        the rewritten file and the queue. ``None`` = fence at the
+        current seq (drop everything enqueued so far — the caller
+        guarantees its snapshot covers the present instant).
+
+        Quiesces the drainer (bounded), then does the file work off
+        the lock — ``_rotating`` keeps the drainer from re-opening
+        mid-swap."""
+        import time as time_mod
+
+        deadline = time_mod.time() + 2.0
         with self._cond:
-            self._queue = []
+            fence = self._seq if up_to_seq is None else up_to_seq
+            self._queue = [entry for entry in self._queue
+                           if entry[0] >= fence]
+            while self._in_flight:
+                remaining = deadline - time_mod.time()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    break     # proceed anyway; the write path survives
+            self._rotating = True
+            self._rotations += 1
+            self._rotate_cutoff = fence
+            handle, self._file = self._file, None
+        try:
+            if handle is not None:
+                handle.close()
+            # rewrite instead of truncate: drained entries at/after
+            # the fence are post-export and must survive the rotation
+            survivors = []
             try:
-                if self._file is not None:
-                    self._file.close()
-                    self._file = None
-                tmp = f"{self._path}.{os.getpid()}.tmp"
-                with open(tmp, "w"):
-                    pass
-                os.replace(tmp, self._path)
-            except OSError as e:
-                logger.warning("mutation log rotate failed: %s", e)
+                with open(self._path) as f:
+                    for raw in f:
+                        raw = raw.strip()
+                        if not raw:
+                            continue
+                        try:
+                            if int(json.loads(raw)["seq"]) >= fence:
+                                survivors.append(raw)
+                        except (ValueError, KeyError, TypeError):
+                            continue   # torn line: gone either way
+            except OSError:
+                pass
+            tmp = f"{self._path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                if survivors:
+                    f.write("\n".join(survivors) + "\n")
+            os.replace(tmp, self._path)
+        except OSError as e:
+            logger.warning("mutation log rotate failed: %s", e)
+        finally:
+            with self._cond:
+                self._rotating = False
+                self._cond.notify_all()
 
     def close(self) -> None:
         self.flush(timeout_s=2.0)
@@ -184,14 +291,15 @@ class MutationLog:
             self._stopped = True
             self._cond.notify_all()
             thread = self._thread
-            if self._file is not None:
-                try:
-                    self._file.close()
-                except OSError:
-                    pass
-                self._file = None
         if thread is not None:
             thread.join(timeout=2.0)
+        with self._cond:
+            handle, self._file = self._file, None
+        if handle is not None:
+            try:
+                handle.close()
+            except OSError:
+                pass
 
     @staticmethod
     def read(directory: str) -> List[Tuple[str, bytes]]:

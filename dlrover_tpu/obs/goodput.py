@@ -105,6 +105,7 @@ class GoodputLedger:
         self._buckets: Dict[int, Dict[str, float]] = {}
         # rank lifetime: first_seen/last_activity/gone timestamps
         self._first_seen: Dict[int, float] = {}
+        # graftlint: ephemeral(export is timestamp-free by design)
         self._last_activity: Dict[int, float] = {}
         self._gone: Dict[int, float] = {}
         self._state: Dict[int, str] = {}            # current activity
@@ -115,6 +116,7 @@ class GoodputLedger:
         self._draining_since: Dict[int, Tuple[float, float]] = {}
         self._last_step: Dict[int, int] = {}
         self._last_report_ts: Dict[int, float] = {}
+        # graftlint: ephemeral(re-learned from the next step reports)
         self._mfu: Dict[int, float] = {}
         # multi-slice hierarchical DP: rank → slice (rendezvous slice
         # registry), per-rank degraded-step tallies (steps taken with
@@ -124,15 +126,20 @@ class GoodputLedger:
         # with, even across a slice-map update)
         self._slice_map: Dict[int, int] = {}
         self._degraded_steps: Dict[int, int] = {}
+        # graftlint: ephemeral(gauge label memory; republished)
         self._state_slice: Dict[int, str] = {}
+        # graftlint: ephemeral(span dedup; dead spans cannot recur)
         self._seen_span_ids: deque = deque(maxlen=_SEEN_SPAN_CAP)
+        # graftlint: ephemeral(mirror of _seen_span_ids)
         self._seen_set: set = set()
         # online parallelism re-plans: the replan_plan/replan_migrate/
         # replan_rebuild sub-phase spans (nested inside the restore/
         # compile evidence — recorded here for the per-resize summary,
         # NOT accrued again as wall-clock)
+        # graftlint: ephemeral(timestamped; excluded from export)
         self._replans: deque = deque(maxlen=64)
         # (ts, rank, bucket, seconds) for windowed summaries
+        # graftlint: ephemeral(window samples; outage reads as idle)
         self._window: deque = deque(maxlen=_WINDOW_CAP)
         self._job_start = self._now()
         self._incarnations: deque = deque(maxlen=_INCARNATION_CAP)

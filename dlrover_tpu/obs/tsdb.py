@@ -151,6 +151,7 @@ class TimeSeriesStore:
         self._series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
                            _Series] = {}
         self._dropped_series = 0
+        # graftlint: ephemeral(stats tally reported in query stats; not history)
         self._ingested = 0
 
     # -- write path --------------------------------------------------------

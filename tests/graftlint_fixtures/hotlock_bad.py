@@ -1,0 +1,40 @@
+# graftlint fixture: deliberate hot-path blocking violations. Never
+# imported/executed; `# BAD: <rule>` markers are asserted exactly.
+import os
+import threading
+
+
+class StepTimeline:
+    """Hot by name (gradient-path lock owner set)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+        self._file = None
+
+    def record(self, row):
+        with self._lock:
+            self._rows.append(row)
+            self._flush_locked()
+
+    def _flush_locked(self):
+        # entry lockset: every call site holds the lock
+        self._file.write("x")                     # BAD: GL501
+        os.fsync(0)                               # BAD: GL501
+
+    def dump(self):
+        with self._lock:
+            handle = open("/tmp/x", "w")          # BAD: GL501
+            return handle
+
+
+class RingExchange:  # graftlint: hot-path
+    """Opted in via the hot-path marker."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._client = None
+
+    def put(self, item):
+        with self._lock:
+            self._client.push(item)               # BAD: GL501

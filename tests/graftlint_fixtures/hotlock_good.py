@@ -1,0 +1,34 @@
+# graftlint fixture: the safe mirror of hotlock_bad — file/RPC work
+# happens OUTSIDE the hot lock, and an ordinary (non-hot) class may
+# write under its own lock without GL501. Must be completely silent.
+import threading
+
+
+class StepTimeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def record(self, row):
+        with self._lock:
+            self._rows.append(row)
+
+    def dump(self):
+        with self._lock:
+            rows = list(self._rows)
+        with open("/tmp/x", "w") as sink:
+            sink.write(str(rows))
+        return rows
+
+
+class ColdSink:
+    """Not a gradient-path lock owner: the extended blocking set does
+    not apply (GL203's classic set still would)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._file = open("/tmp/cold", "a")
+
+    def put(self, line):
+        with self._lock:
+            self._file.write(line)

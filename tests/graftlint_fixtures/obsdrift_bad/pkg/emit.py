@@ -1,0 +1,11 @@
+# graftlint fixture (obs-drift): emission sites vs the catalog.
+import obs
+
+
+def boot(registry, recorder):
+    registry.counter("fix_steps_total", "steps").inc()
+    registry.gauge("fix_secret_gauge", "hidden").set(1)   # BAD: GL602
+    recorder.record_event("fix_boot")
+    recorder.record_event("fix_mystery")          # BAD: GL602
+    with obs.span("fix_step"):
+        pass

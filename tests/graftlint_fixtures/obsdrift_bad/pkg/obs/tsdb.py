@@ -1,0 +1,5 @@
+# graftlint fixture (obs-drift): the dashboard series contract.
+DASHBOARD_SERIES = (
+    "fix_steps_total",
+    "fix_unfed_series",                           # BAD: GL603
+)

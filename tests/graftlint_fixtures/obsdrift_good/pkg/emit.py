@@ -1,0 +1,9 @@
+# graftlint fixture (obs-drift): emission sites matching the catalog.
+import obs
+
+
+def boot(registry, recorder):
+    registry.counter("fix_steps_total", "steps").inc()
+    recorder.record_event("fix_boot")
+    with obs.span("fix_step"):
+        pass

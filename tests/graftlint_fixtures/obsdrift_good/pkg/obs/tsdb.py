@@ -1,0 +1,4 @@
+# graftlint fixture (obs-drift): every dashboard series is fed.
+DASHBOARD_SERIES = (
+    "fix_steps_total",
+)

@@ -1,0 +1,22 @@
+# graftlint fixture (protocol-symmetry): the client side. `# BAD`
+# markers are asserted exactly by tests/test_graftlint.py.
+from pkg.common import messages as msg
+
+
+class Client:
+    def _typed(self, request, expected):
+        return expected
+
+    def _send(self, request):
+        return request
+
+    def ping(self):
+        reply = self._typed(msg.PingRequest(node_id=1, token="t"),
+                            msg.PingReply)
+        return reply.round
+
+    def stray(self):
+        return self._send(msg.StrayRequest())     # BAD: GL402
+
+    def is_hot(self, key):
+        return key.startswith("hot/")             # BAD: GL403

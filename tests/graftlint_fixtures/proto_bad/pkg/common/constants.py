@@ -1,0 +1,6 @@
+# graftlint fixture (protocol-symmetry): the single-sourced contract.
+class NodeEnv:
+    MASTER_ADDR = "PROTO_FIX_MASTER_ADDR"
+
+
+HOT_PREFIXES = ("hot/",)

@@ -1,0 +1,22 @@
+# graftlint fixture (protocol-symmetry): the message vocabulary.
+class Message:
+    pass
+
+
+class PingRequest(Message):
+    node_id: int = -1
+    token: str = ""
+    deadline: float = 0.0
+
+
+class PingReply(Message):
+    round: int = 0
+    debug_tag: str = ""
+
+
+class OrphanRequest(Message):
+    node_id: int = -1
+
+
+class StrayRequest(Message):
+    node_id: int = -1

@@ -1,0 +1,19 @@
+# graftlint fixture (protocol-symmetry): the dispatch side. `# BAD`
+# markers are asserted exactly by tests/test_graftlint.py.
+import os
+
+from pkg.common import messages as msg
+
+
+class Servicer:
+    def get(self, request):
+        if isinstance(request, msg.PingRequest):
+            if request.token and request.node_id >= 0:
+                grace = request.deadline          # BAD: GL401
+                return msg.PingReply(round=1, debug_tag=str(grace))  # BAD: GL401
+        if isinstance(request, msg.OrphanRequest):  # BAD: GL402
+            return msg.PingReply(round=0)
+        return None
+
+    def resolve(self):
+        return os.environ.get("PROTO_FIX_MASTER_ADDR", "")  # BAD: GL403

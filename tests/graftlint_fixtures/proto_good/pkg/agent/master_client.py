@@ -1,0 +1,16 @@
+# graftlint fixture (protocol-symmetry): the symmetric client side.
+from pkg.common import messages as msg
+from pkg.common.constants import HOT_PREFIXES
+
+
+class Client:
+    def _typed(self, request, expected):
+        return expected
+
+    def ping(self):
+        reply = self._typed(msg.PingRequest(node_id=1, token="t"),
+                            msg.PingReply)
+        return reply.round
+
+    def is_hot(self, key):
+        return key.startswith(HOT_PREFIXES)

@@ -1,0 +1,14 @@
+# graftlint fixture (protocol-symmetry): the safe mirror — every field
+# set where constructed and read on the other side, every dispatched
+# type reachable from the client. Must be completely silent.
+class Message:
+    pass
+
+
+class PingRequest(Message):
+    node_id: int = -1
+    token: str = ""
+
+
+class PingReply(Message):
+    round: int = 0

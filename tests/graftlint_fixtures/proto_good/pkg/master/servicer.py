@@ -1,0 +1,16 @@
+# graftlint fixture (protocol-symmetry): the symmetric dispatch side.
+import os
+
+from pkg.common import messages as msg
+from pkg.common.constants import NodeEnv
+
+
+class Servicer:
+    def get(self, request):
+        if isinstance(request, msg.PingRequest):
+            if request.token and request.node_id >= 0:
+                return msg.PingReply(round=1)
+        return None
+
+    def resolve(self):
+        return os.environ.get(NodeEnv.MASTER_ADDR, "")

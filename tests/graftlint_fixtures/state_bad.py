@@ -1,0 +1,30 @@
+# graftlint fixture: deliberate state-roundtrip violations. Never
+# imported/executed; `# BAD: <rule>` markers are asserted exactly.
+import threading
+
+
+class LeakyStore:
+    """Participates in the state backend but loses state on failover."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rounds = {}
+        self._ledger = {}                         # BAD: GL301
+        self._typed_ledger: dict = {}             # BAD: GL301
+        self._peak = 0.0                          # BAD: GL301
+        # graftlint: ephemeral(scratch cache rebuilt on demand)
+        self._cache = {}
+
+    def bump(self, key):
+        with self._lock:
+            self._peak += 1.0
+            self._rounds[key] = self._peak
+            self._ledger[key] = 1
+
+    def export_state(self):
+        return {"rounds": dict(self._rounds),     # BAD: GL302
+                "epoch": 3}
+
+    def restore_state(self, state):
+        self._rounds = dict(state.get("rounds", {}))
+        self._ghost = state.get("ghost", 0)       # BAD: GL302

@@ -1,0 +1,33 @@
+# graftlint fixture: the safe mirror of state_bad — full roundtrip
+# coverage, annotated ephemerals, symmetric snapshot keys. Must be
+# completely silent.
+import threading
+
+
+class TightStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rounds = {}
+        self._ledger = {}
+        # annotated-assignment style is covered the same way
+        # graftlint: ephemeral(scratch; annotated-assignment form)
+        self._typed_scratch: dict = {}
+        # graftlint: ephemeral(scratch cache rebuilt on demand)
+        self._cache = {}
+        # graftlint: ephemeral(wall-clock anchor of this incarnation)
+        self._started_at = 0.0
+
+    def bump(self, key):
+        with self._lock:
+            self._started_at = 1.0
+            self._rounds[key] = 1
+            self._ledger[key] = 1
+
+    def export_state(self):
+        return {"rounds": dict(self._rounds),
+                "ledger": dict(self._ledger),
+                "version": 1}
+
+    def restore_state(self, state):
+        self._rounds = dict(state.get("rounds", {}))
+        self._ledger = dict(state.get("ledger", {}))

@@ -345,6 +345,26 @@ class TestMutationLog:
             ("dcn/g1/state", b"after")]
         log.close()
 
+    def test_rotate_fence_preserves_post_export_entries(self, tmp_path):
+        """The seq fence: a hot mutation landing AFTER the snapshot's
+        kv export but before rotate() is in NEITHER the snapshot nor a
+        naively-truncated log — the fenced rotation must keep it (and
+        only it), so it stays durable until the next rotation."""
+        log = MutationLog(str(tmp_path))
+        log.append("coord/t/0", b"pre-export")
+        fence = log.current_seq()     # sampled before the export
+        log.append("coord/t/0", b"post-export")
+        assert log.flush()
+        log.rotate(up_to_seq=fence)
+        assert log.flush()
+        # only the post-export entry survives: replaying the
+        # pre-export one could REGRESS the key over the snapshot's
+        # newer value (it is covered by the snapshot; the survivor
+        # is not covered by anything else)
+        assert MutationLog.read(str(tmp_path)) == [
+            ("coord/t/0", b"post-export")]
+        log.close()
+
     def test_gate_discards_instead_of_writing(self, tmp_path):
         """The fence hook: a gated (superseded) master's drainer drops
         entries rather than corrupting the promoted lineage's log —

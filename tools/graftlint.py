@@ -1,17 +1,25 @@
 #!/usr/bin/env python3
-"""graftlint CLI — trace-safety + lock-discipline gate.
+"""graftlint CLI — the fleet's distributed-contracts gate.
 
 Usage:
     python tools/graftlint.py dlrover_tpu            # gate (exit 1 on NEW)
     python tools/graftlint.py --list-rules
-    python tools/graftlint.py --json dlrover_tpu
+    python tools/graftlint.py --format json dlrover_tpu
+    python tools/graftlint.py --format github dlrover_tpu   # CI annotations
     python tools/graftlint.py --write-baseline dlrover_tpu
-    python tools/graftlint.py --no-baseline dlrover_tpu   # full report
+    python tools/graftlint.py --no-baseline dlrover_tpu     # full report
+    python tools/graftlint.py --stats dlrover_tpu           # cache hit rate
 
 Exit codes: 0 = no new findings; 1 = new findings (not in the baseline);
 2 = usage/parse error. The baseline lives at tools/graftlint_baseline.json
 and suppresses accepted pre-existing findings by stable fingerprint —
 see docs/static_analysis.md for when (not) to regenerate it.
+
+Per-file results are cached in tools/.graftlint_cache.json keyed by
+(path, mtime_ns, size, rules-version); --no-cache forces a cold run.
+The obs-catalog drift check (docs/observability.md ↔ emitted names)
+runs whenever the analyzed roots include the obs/ tree; --obs-doc
+points it at a different catalog (fixtures/tests).
 """
 
 from __future__ import annotations
@@ -33,6 +41,25 @@ from dlrover_tpu.analysis import (                       # noqa: E402
 
 DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
                                 "graftlint_baseline.json")
+DEFAULT_CACHE = os.path.join(_REPO_ROOT, "tools",
+                             ".graftlint_cache.json")
+DEFAULT_OBS_DOC = os.path.join(_REPO_ROOT, "docs", "observability.md")
+
+
+def _roots_cover_obs(roots) -> bool:
+    """The drift check needs the obs/ emitters in scope — a partial run
+    over one module must not report half the catalog as dead."""
+    for root in roots:
+        absroot = os.path.abspath(root)
+        if os.path.isdir(absroot) and os.path.isdir(
+                os.path.join(absroot, "obs")):
+            return True
+    return False
+
+
+def _github_escape(text: str) -> str:
+    return (text.replace("%", "%25").replace("\r", "%0D")
+            .replace("\n", "%0A"))
 
 
 def main(argv=None) -> int:
@@ -49,9 +76,25 @@ def main(argv=None) -> int:
                         help="accept current findings into the baseline")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    parser.add_argument("--format", choices=("text", "json", "github"),
+                        default="text", dest="fmt",
+                        help="output format (github = workflow "
+                             "annotation lines)")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="machine-readable output")
+                        help="alias for --format json")
+    parser.add_argument("--cache", default=DEFAULT_CACHE,
+                        help="per-file analysis cache path")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="ignore and do not write the cache")
+    parser.add_argument("--stats", action="store_true",
+                        help="print cache hit rate and wall time")
+    parser.add_argument("--obs-doc", default=DEFAULT_OBS_DOC,
+                        help="observability catalog for the drift check")
+    parser.add_argument("--no-obs-drift", action="store_true",
+                        help="skip the docs/observability.md drift check")
     args = parser.parse_args(argv)
+    if args.as_json:
+        args.fmt = "json"
 
     if args.list_rules:
         for rule in sorted(RULES.values(), key=lambda r: r.rule_id):
@@ -68,7 +111,13 @@ def main(argv=None) -> int:
             print(f"graftlint: bad baseline: {e}", file=sys.stderr)
             return 2
 
-    result = run_analysis(roots, baseline=baseline)
+    obs_doc = None
+    if not args.no_obs_drift and _roots_cover_obs(roots):
+        obs_doc = args.obs_doc
+    result = run_analysis(
+        roots, baseline=baseline,
+        cache_path=None if args.no_cache else args.cache,
+        obs_doc=obs_doc)
 
     if args.write_baseline:
         if result.parse_errors:
@@ -88,7 +137,7 @@ def main(argv=None) -> int:
 
     report = result.new_findings if baseline is not None \
         else result.findings
-    if args.as_json:
+    if args.fmt == "json":
         print(json.dumps({
             "files_analyzed": result.files_analyzed,
             "total_findings": len(result.findings),
@@ -99,7 +148,19 @@ def main(argv=None) -> int:
                 for f in report
             ],
             "parse_errors": result.parse_errors,
+            "cache": {"hits": result.cache_hits,
+                      "misses": result.cache_misses},
+            "wall_time_s": round(result.wall_time_s, 3),
         }, indent=2))
+    elif args.fmt == "github":
+        # one workflow-annotation line per finding: GitHub surfaces
+        # these inline on the PR diff with no extra tooling
+        for f in report:
+            print(f"::error file={f.path},line={f.line},"
+                  f"col={f.col + 1},title={f.rule_id}::"
+                  f"{_github_escape(f.message)}")
+        print(f"graftlint: {result.files_analyzed} files, "
+              f"{len(report)} finding(s)")
     else:
         for f in report:
             print(f.format())
@@ -108,6 +169,13 @@ def main(argv=None) -> int:
                 if baseline is not None and suppressed else "")
         print(f"graftlint: {result.files_analyzed} files, "
               f"{len(report)} finding(s){tail}")
+    if args.stats and args.fmt != "json":
+        # json output already embeds cache/wall stats; a trailing
+        # human line would corrupt stdout for machine consumers
+        total = result.cache_hits + result.cache_misses
+        rate = (100.0 * result.cache_hits / total) if total else 0.0
+        print(f"graftlint: cache {result.cache_hits}/{total} hits "
+              f"({rate:.0f}%), wall {result.wall_time_s:.2f}s")
     for err in result.parse_errors:
         print(f"graftlint: parse error: {err}", file=sys.stderr)
     if result.parse_errors:

@@ -1,12 +1,20 @@
 #!/usr/bin/env bash
-# Lint gate: ruff (style/pyflakes/isort) + graftlint (trace-safety +
-# lock-discipline). Non-zero exit on any NEW finding. Referenced from
+# Lint gate: ruff (style/pyflakes/isort) + graftlint (the distributed-
+# contracts suite). Non-zero exit on any NEW finding. Referenced from
 # README's development section; run before sending a PR.
 #
-#   tools/lint.sh             # lint dlrover_tpu (the package)
-#   tools/lint.sh path ...    # lint specific paths
+#   tools/lint.sh                      # lint dlrover_tpu (the package)
+#   tools/lint.sh path ...             # lint specific paths
+#   tools/lint.sh --format github ...  # CI workflow-annotation output
 set -u
 cd "$(dirname "$0")/.."
+
+graftlint_args=()
+if [ "${1:-}" = "--format" ] && [ $# -ge 2 ]; then
+    # passed through to graftlint only (ruff keeps its own format)
+    graftlint_args=(--format "$2")
+    shift 2
+fi
 
 targets=("$@")
 if [ ${#targets[@]} -eq 0 ]; then
@@ -25,6 +33,7 @@ else
 fi
 
 echo "== graftlint =="
-python tools/graftlint.py "${targets[@]}" || rc=1
+python tools/graftlint.py ${graftlint_args[@]+"${graftlint_args[@]}"} \
+    "${targets[@]}" || rc=1
 
 exit $rc
