@@ -491,7 +491,14 @@ class MasterClient:
             plan = json.loads(result.plan_json)
         except json.JSONDecodeError:
             return {}
-        return plan if isinstance(plan, dict) else {}
+        if not isinstance(plan, dict):
+            return {}
+        # same contract as get_shard_plan: the envelope's epoch is
+        # authoritative, and the commit-time staleness guard
+        # (get_restore_epoch) compares against the stamp on the plan —
+        # a plan without it would always look fresh
+        plan.setdefault("epoch", result.epoch)
+        return plan
 
     @retry_rpc(retries=3)
     def get_restore_epoch(self, rdzv_name: str = RendezvousName.TRAINING

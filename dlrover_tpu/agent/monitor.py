@@ -312,10 +312,14 @@ class HangingDetector:
         self._warmup_s = warmup_s
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # the grace-period clock is reset from the agent thread
+        # (worker restart) while the detector thread reads it
+        self._clock_lock = threading.Lock()
         self._started_at = time.time()
 
     def start(self) -> None:
-        self._started_at = time.time()
+        with self._clock_lock:
+            self._started_at = time.time()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="hang-detector")
         self._thread.start()
@@ -325,18 +329,21 @@ class HangingDetector:
 
     def reset(self) -> None:
         """Call after a worker restart (fresh compile grace period)."""
-        self._started_at = time.time()
+        with self._clock_lock:
+            self._started_at = time.time()
 
     def is_hanged(self) -> bool:
         record = _read_last_step(self._metrics_file)
         now = time.time()
+        with self._clock_lock:
+            started_at = self._started_at
         if record is None:
             # no step ever: hang only after warmup (first compile is slow)
-            return now - self._started_at > max(self._warmup_s,
-                                                self._hang_seconds)
+            return now - started_at > max(self._warmup_s,
+                                          self._hang_seconds)
         # a stale record from before the last (re)start must not re-fire:
         # progress is the newer of last-step time and last restart time
-        last_progress = max(record["ts"], self._started_at)
+        last_progress = max(record["ts"], started_at)
         return now - last_progress > self._hang_seconds
 
     def _loop(self) -> None:
@@ -362,6 +369,9 @@ class ParalConfigTuner:
         self._interval_s = interval_s
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # poll_once runs on the tuner thread and directly from tests /
+        # agent shutdown: the version check-and-set must be atomic
+        self._version_lock = threading.Lock()
         self._last_version = -1
 
     def start(self) -> None:
@@ -374,9 +384,10 @@ class ParalConfigTuner:
 
     def poll_once(self) -> bool:
         config = self._client.get_paral_config()
-        if config.version <= self._last_version:
-            return False
-        self._last_version = config.version
+        with self._version_lock:
+            if config.version <= self._last_version:
+                return False
+            self._last_version = config.version
         tmp = self._config_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({

@@ -20,12 +20,32 @@ AST passes purpose-built for this codebase's failure modes:
 - obs-drift (GL6xx, cross-artifact): docs/observability.md catalogs and
   obs/tsdb.DASHBOARD_SERIES must match what the code actually emits,
   both directions.
+- graftrace (GL7xx): the fleet's concurrency model as contracts — the
+  whole-program thread roster (GL701), the project lock-order graph
+  pinned to docs/fault_tolerance.md (GL702, cross-module), fence-gate
+  discipline for master state-dir writers (GL703, cross-module) and
+  epoch/generation staleness discipline for hot-KV keys and stamped
+  plans (GL704).  The runtime half (``lockcheck``) validates the
+  static GL702 model under tier-1 via ``tools/graftrace.py``.
 
 Entry points: ``tools/graftlint.py`` (CLI + CI gate),
 ``run_analysis`` (library), ``tests/test_graftlint.py`` (tier-1 gate).
 See docs/static_analysis.md for the rule catalog.
 """
 
+from dlrover_tpu.analysis.concurrency import (    # noqa: F401
+    ConcurrencyPass,
+    analyze_concurrency,
+    build_lock_model,
+    check_lock_order,
+    find_cycles,
+    parse_lock_table,
+)
+from dlrover_tpu.analysis.contracts import (      # noqa: F401
+    StalenessPass,
+    check_fence,
+    extract_fence_facts,
+)
 from dlrover_tpu.analysis.findings import (       # noqa: F401
     Finding,
     RULES,

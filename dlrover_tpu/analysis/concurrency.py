@@ -1,0 +1,895 @@
+"""graftrace static side: thread roster + project lock-order graph.
+
+Two rules ride on one scan:
+
+GL701  *cross-thread unguarded access* — per file, the pass builds the
+       class's thread roster (every ``Thread(target=...)``/``Timer``/
+       executor-submit site, plus RPC servicer entry points as implicit
+       threads), propagates thread contexts over the internal call
+       graph, and flags instance attributes written after thread start
+       whose accesses span several contexts with NO lock common to all
+       of them.  This is the cross-thread escalation of GL205 (which
+       only counts same-class writers) and of GL201 (whose majority
+       vote needs two guarded accesses before it fires).
+
+GL702  *lock-order graph* — per file the pass EXPORTS facts: every
+       acquired-while-held edge (lexical nesting, "(lock held)" helper
+       entry locksets, and calls into *other* lock-owning classes while
+       a lock is held), every lock definition, and module factory
+       functions that return lock owners.  The pooled checker
+       (:func:`check_lock_order`) then assembles the project-wide
+       graph, fails on cycles, and diffs the graph both directions
+       against the canonical hierarchy table in
+       ``docs/fault_tolerance.md`` — same contract pattern as the
+       obs-catalog drift check.
+
+The runtime half of graftrace (``analysis/lockcheck.py``) validates
+this static model under tier-1: the observed acquisition graph must be
+a subset of the model here (``tools/graftrace.py --diff``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dlrover_tpu.analysis.findings import Finding
+from dlrover_tpu.analysis.lock_discipline import (
+    _SKIP_METHODS,
+    _ClassFamily,
+    _MethodScan,
+    _module_lock_names,
+    entry_locksets,
+    group_class_families,
+)
+from dlrover_tpu.analysis.trace_safety import (
+    _dotted_name,
+    _import_aliases,
+)
+
+# Thread spawn vocabulary: constructor heads (resolved through import
+# aliases) and the executor-submit method form.
+_SPAWN_HEADS = {
+    "threading.Thread": "thread",
+    "threading.Timer": "timer",
+    "concurrent.futures.ThreadPoolExecutor": "executor",
+    "futures.ThreadPoolExecutor": "executor",
+    "ThreadPoolExecutor": "executor",
+}
+# classes whose public methods run on RPC pool threads (one implicit
+# thread context per endpoint): the naming convention the master's
+# servicer/coord/KV classes follow
+_SERVICER_SUFFIXES = ("Servicer", "Service")
+
+_TOKEN_RE = re.compile(r"epoch|generation|round|token|stamp", re.I)
+
+
+def _module_stem(relpath: str) -> str:
+    """Last module-path segment, matching the runtime sanitizer's
+    naming (``obs/__init__.py`` locks live on module ``...obs``)."""
+    parts = relpath.split("/")
+    base = parts[-1]
+    if base == "__init__.py" and len(parts) > 1:
+        return parts[-2]
+    return base[:-3] if base.endswith(".py") else base
+
+
+def _class_like(name: str) -> bool:
+    """CamelCase last segment (underscore-private ``_Family`` counts)."""
+    last = name.rsplit(".", 1)[-1].lstrip("_")
+    return last[:1].isupper()
+
+
+class _ConcScan(_MethodScan):
+    """_MethodScan + spawn sites, lock acquisitions, and calls made on
+    other objects while a lock is held."""
+
+    def __init__(self, owner, method_name: str):
+        super().__init__(owner, method_name)
+        # (kind, target_kind, target, line)
+        self.spawns: List[Tuple[str, str, str, int]] = []
+        # (lock_id, line) for every `with <lock>` entry
+        self.acquisitions: List[Tuple[str, int]] = []
+        # calls on self.<attr>.<meth>() / factory().<meth>() and bare
+        # ctor/factory calls: (held locks, receiver head, line, kind)
+        self.held_calls: List[
+            Tuple[Tuple[str, ...], str, int, str]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            lock = self._lock_id(item.context_expr)
+            if lock is not None:
+                self.acquisitions.append((lock, item.context_expr.lineno))
+                for outer in self.held:
+                    if outer != lock:
+                        self.order_pairs.append(
+                            (outer, lock, item.context_expr, self.method))
+                self.held.append(lock)
+                pushed += 1
+            else:
+                self.visit(item.context_expr)
+                if item.optional_vars is not None:
+                    self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._record_spawn(node)
+        self._record_held_call(node)
+        super().visit_Call(node)
+
+    # -- spawn sites -------------------------------------------------------
+    def _record_spawn(self, node: ast.Call) -> None:
+        head = _dotted_name(node.func, self.owner.aliases)
+        kind = _SPAWN_HEADS.get(head or "")
+        target: Optional[ast.AST] = None
+        if kind == "thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+        elif kind == "timer":
+            for kw in node.keywords:
+                if kw.arg == "function":
+                    target = kw.value
+            if target is None and len(node.args) >= 2:
+                target = node.args[1]
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "submit" and node.args):
+            recv = node.func.value
+            text = recv.attr if isinstance(recv, ast.Attribute) else (
+                recv.id if isinstance(recv, ast.Name) else "")
+            if any(t in text.lower() for t in ("executor", "pool")):
+                kind, target = "executor", node.args[0]
+        if kind is None and target is None:
+            return
+        tk, name = "inline", "<lambda>"
+        if isinstance(target, ast.Attribute) and isinstance(
+                target.value, ast.Name) and target.value.id in ("self",
+                                                                "cls"):
+            tk, name = "method", target.attr
+        elif isinstance(target, ast.Name):
+            tk, name = "name", target.id
+        elif target is None:
+            return
+        self.spawns.append((kind or "executor", tk, name, node.lineno))
+
+    # -- cross-object calls (lock relevance decided at emission: the
+    # caller's ENTRY lockset counts too, so record even when nothing is
+    # lexically held here) --------------------------------------------------
+    def _record_held_call(self, node: ast.Call) -> None:
+        head, kind = "", "call"
+        if isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if isinstance(base, ast.Attribute) and isinstance(
+                    base.value, ast.Name) and base.value.id in ("self",
+                                                                "cls"):
+                head = f"self.{base.attr}"
+            elif isinstance(base, ast.Call):
+                inner = _dotted_name(base.func, self.owner.aliases)
+                if inner:
+                    head = inner
+        elif isinstance(node.func, ast.Name):
+            # a bare constructor / factory call binds the class into
+            # this family's reach (closure fodder, not an order edge:
+            # constructing a lock owner does not acquire its lock)
+            resolved = _dotted_name(node.func, self.owner.aliases)
+            if resolved:
+                head, kind = resolved, "ctor"
+        if head:
+            self.held_calls.append((tuple(self.held), head,
+                                    node.lineno, kind))
+
+
+def _family_bindings(family: _ClassFamily) -> Dict[str, str]:
+    """``self.X = ClassName(...)`` / ``self.X = factory()`` bindings:
+    attr -> call head, for resolving held-call receivers."""
+    out: Dict[str, str] = {}
+    for _, meth in family.methods:
+        for node in ast.walk(meth):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            head = _dotted_name(node.value.func, family.aliases)
+            if not head:
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in ("self", "cls")):
+                    out.setdefault(tgt.attr, head)
+    return out
+
+
+def _module_factories(tree: ast.Module,
+                      aliases: Dict[str, str]) -> Dict[str, str]:
+    """Module functions whose body returns ``ClassName(...)`` or a
+    module-level singleton bound to one — ``get_registry()`` style."""
+    def _cls_name(head: Optional[str]) -> str:
+        # aliases resolve imported classes to dotted paths
+        # (pkg.beta.Beta): the class-ness test is on the LAST segment
+        last = (head or "").rsplit(".", 1)[-1]
+        return last if _class_like(last) else ""
+
+    singleton: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            cls = _cls_name(_dotted_name(node.value.func, aliases))
+            if cls:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        singleton[tgt.id] = cls
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        # lazy singletons assign the global INSIDE the factory:
+        # ``global _reg; if _reg is None: _reg = Cls(); return _reg``
+        local = dict(singleton)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and isinstance(
+                    sub.value, ast.Call):
+                cls = _cls_name(_dotted_name(sub.value.func, aliases))
+                if cls:
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            local.setdefault(tgt.id, cls)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Return) or sub.value is None:
+                continue
+            val = sub.value
+            if isinstance(val, ast.Call):
+                cls = _cls_name(_dotted_name(val.func, aliases))
+                if cls:
+                    out[node.name] = cls
+            elif isinstance(val, ast.Name) and val.id in local:
+                out[node.name] = local[val.id]
+    return out
+
+
+def analyze_concurrency(
+        relpath: str, tree: ast.Module,
+        source_lines: Sequence[str]) -> Tuple[List[Finding], Dict]:
+    """One file: GL701 findings + GL702 facts for the pooled checker."""
+    aliases = _import_aliases(tree)
+    stem = _module_stem(relpath)
+    module_locks = _module_lock_names(tree, aliases)
+    classes = [n for n in tree.body if isinstance(n, ast.ClassDef)]
+
+    findings: List[Finding] = []
+    locks: List[Dict] = [
+        {"id": f"{stem}.{name}", "owner": stem, "kind": "module"}
+        for name in sorted(module_locks)]
+    edges: List[Dict] = []
+    calls: List[Dict] = []
+    binds: List[Dict] = []
+    families: List[Dict] = []
+    threads: List[Dict] = []
+    modfuncs: List[Dict] = []
+
+    def _qual(lock_id: str) -> str:
+        return lock_id.replace("<module>.", f"{stem}.")
+
+    def _src(line: int) -> str:
+        if 1 <= line <= len(source_lines):
+            return source_lines[line - 1]
+        return ""
+
+    for root, members in group_class_families(classes):
+        family = _ClassFamily(root, members, aliases, relpath,
+                              module_locks)
+        for attr in sorted(family.lock_attrs):
+            locks.append({"id": f"{family.name}.{attr}",
+                          "owner": family.name, "kind": "class"})
+        bindings = _family_bindings(family)
+        # classes/factories this family calls into, for the runtime
+        # diff's transitive closure (non-classes fall out at pool time)
+        callee_names: Set[str] = set()
+        scans: Dict[str, _ConcScan] = {}
+        for cls, meth in family.methods:
+            scan = _ConcScan(family, meth.name)
+            for stmt in meth.body:
+                scan.visit(stmt)
+            scans[f"{cls.name}.{meth.name}"] = scan
+        entries = entry_locksets(scans)
+
+        # -- GL702 facts ---------------------------------------------------
+        for key, scan in scans.items():
+            meth_name = key.split(".", 1)[1]
+            for outer, inner, node, _ in scan.order_pairs:
+                edges.append({"outer": _qual(outer),
+                              "inner": _qual(inner),
+                              "line": node.lineno,
+                              "srcline": _src(node.lineno),
+                              "symbol": key})
+            entry = entries.get(meth_name, frozenset())
+            for lock, line in scan.acquisitions:
+                for held in entry:
+                    if held != lock:
+                        edges.append({"outer": _qual(held),
+                                      "inner": _qual(lock),
+                                      "line": line,
+                                      "srcline": _src(line),
+                                      "symbol": key})
+            for held, head, line, kind in scan.held_calls:
+                recv = head
+                if head.startswith("self."):
+                    recv = bindings.get(head[5:], "")
+                if not recv:
+                    continue
+                recv = recv.rsplit(".", 1)[-1]
+                callee_names.add(recv)
+                # a helper whose every caller holds a lock ("(lock
+                # held)" entry lockset) makes its calls lock-held too.
+                # Ctor sites stay facts as well: constructing a lock
+                # owner acquires nothing, but a bare-name call can be
+                # a module FUNCTION that takes a module lock — the
+                # pool tells those apart by the kind tag.
+                for h in sorted(set(held) | set(entry)):
+                    calls.append({"held": _qual(h), "head": recv,
+                                  "line": line,
+                                  "srcline": _src(line),
+                                  "symbol": key, "kind": kind})
+            for kind, tk, name, line in scan.spawns:
+                threads.append({"owner": family.name, "kind": kind,
+                                "target": name, "target_kind": tk,
+                                "line": line, "symbol": key})
+
+        for head in bindings.values():
+            callee_names.add(head.rsplit(".", 1)[-1])
+        if callee_names:
+            binds.append({"owner": family.name,
+                          "callees": sorted(callee_names)})
+        # membership + external bases: the runtime sanitizer names a
+        # lock after the INSTANCE class, which may be a subclass (even
+        # cross-module) of the family that defines the attribute
+        member_names = [c.name for c in family.classes]
+        base_names: Set[str] = set()
+        for c in family.classes:
+            for b in c.bases:
+                last = (_dotted_name(b, aliases) or "").rsplit(
+                    ".", 1)[-1]
+                if last and last not in member_names \
+                        and _class_like(last):
+                    base_names.add(last)
+        families.append({"name": family.name, "members": member_names,
+                         "bases": sorted(base_names)})
+
+        findings.extend(_check_family_threads(family, scans, entries,
+                                              relpath, source_lines))
+
+    # module-level functions: spawns, plus lock facts — which module
+    # locks each function acquires and what it calls while one is
+    # held.  Class code reaching ``obs.get_registry()`` under its own
+    # lock picks up ``metrics._default_lock``; the pool and the
+    # runtime closure need these to model that.
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            owner = _ModuleScanOwner(aliases, module_locks)
+            scan = _ConcScan(owner, node.name)
+            for stmt in node.body:
+                scan.visit(stmt)
+            for kind, tk, name, line in scan.spawns:
+                threads.append({"owner": f"<{stem}>", "kind": kind,
+                                "target": name, "target_kind": tk,
+                                "line": line, "symbol": node.name})
+            for outer, inner, onode, _ in scan.order_pairs:
+                edges.append({"outer": _qual(outer),
+                              "inner": _qual(inner),
+                              "line": onode.lineno,
+                              "srcline": _src(onode.lineno),
+                              "symbol": node.name})
+            fn_callees: Set[str] = set()
+            fn_calls: List[Dict] = []
+            for held, head, line, _kind in scan.held_calls:
+                callee = head.rsplit(".", 1)[-1]
+                fn_callees.add(callee)
+                for h in held:
+                    fn_calls.append({"held": _qual(h), "head": callee,
+                                     "line": line,
+                                     "srcline": _src(line),
+                                     "symbol": node.name})
+            acquired = sorted({_qual(lock)
+                               for lock, _ in scan.acquisitions})
+            if acquired or fn_callees:
+                modfuncs.append({"name": node.name, "locks": acquired,
+                                 "callees": sorted(fn_callees),
+                                 "calls": fn_calls})
+
+    facts: Dict = {}
+    if locks or edges or calls or binds or families or threads \
+            or modfuncs:
+        facts = {"locks": locks, "edges": edges, "calls": calls,
+                 "binds": binds, "families": families,
+                 "threads": threads, "modfuncs": modfuncs,
+                 "factories": _module_factories(tree, aliases)}
+    return findings, facts
+
+
+class _ModuleScanOwner:
+    """Module-function duck-type owner for _ConcScan (mirrors the
+    lock-discipline pass's _ModuleOwner, kept separate to avoid
+    importing a private name)."""
+
+    def __init__(self, aliases: Dict[str, str], module_locks: Set[str]):
+        self.aliases = aliases
+        self.module_locks = module_locks
+        self.lock_attrs: Set[str] = set()
+        self.method_names: Set[str] = set()
+
+    def lock_id(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"<module>.{expr.id}"
+        return None
+
+
+# -- GL701: cross-thread unguarded access -----------------------------------
+
+_VOUCHED_RE = re.compile(
+    r"#\s*graftlint:\s*disable=[^#]*GL(?:201|205|701)")
+
+
+def _check_family_threads(family: _ClassFamily,
+                          scans: Dict[str, _ConcScan],
+                          entries: Dict[str, frozenset],
+                          relpath: str,
+                          source_lines: Sequence[str]) -> List[Finding]:
+    servicer = any(c.name.endswith(_SERVICER_SUFFIXES)
+                   for c in family.classes)
+    thread_entries: Set[str] = set()
+    spawner_methods: Dict[str, int] = {}   # method -> first spawn line
+    for key, scan in scans.items():
+        m = key.split(".", 1)[1]
+        for _, tk, name, line in scan.spawns:
+            if tk == "method":
+                thread_entries.add(name)
+            else:
+                spawner_methods.setdefault(m, line)
+                spawner_methods[m] = min(spawner_methods[m], line)
+    if not thread_entries and not spawner_methods and not servicer:
+        return []
+
+    # base contexts, propagated over the internal call graph.  The
+    # constructor gets its own "init" context: everything it (and the
+    # helpers only it calls) writes is published before Thread.start()
+    # and therefore happens-before every spawned thread's first read —
+    # unless construction itself spawns, which voids the ordering.
+    init_spawns = any(key.split(".", 1)[1] in _SKIP_METHODS
+                      and scan.spawns for key, scan in scans.items())
+    methods = {k.split(".", 1)[1] for k in scans}
+    ctx: Dict[str, Set[str]] = {}
+    for m in methods:
+        s: Set[str] = set()
+        if m in _SKIP_METHODS:
+            s.add("init")
+        elif not m.startswith("_"):
+            s.add(f"rpc:{m}" if servicer else "main")
+        if m in thread_entries:
+            s.add(f"thread:{m}")
+        ctx[m] = s
+    call_edges = [(key.split(".", 1)[1], cs.callee)
+                  for key, scan in scans.items() for cs in scan.calls]
+    changed = True
+    while changed:
+        changed = False
+        for caller, callee in call_edges:
+            if callee in ctx and not ctx[caller] <= ctx[callee]:
+                ctx[callee] |= ctx[caller]
+                changed = True
+    for m in methods:
+        if not ctx[m]:
+            ctx[m] = {"main"}    # externally-driven helper: assume main
+
+    # effective locksets + contexts per access
+    by_attr: Dict[str, List[Tuple[Set[str], Set[str], bool, bool,
+                                  int, int, str]]] = {}
+    for key, scan in scans.items():
+        m = key.split(".", 1)[1]
+        if m in _SKIP_METHODS:
+            continue
+        entry = entries.get(m, frozenset())
+        spawn_line = spawner_methods.get(m)
+        for acc in scan.accesses:
+            if acc.attr not in family.instance_attrs:
+                continue
+            # a per-line lock-discipline suppression (the deliberate
+            # lock-free fast path idiom) vouches the access: it does not
+            # poison the attribute's common lockset
+            if 1 <= acc.line <= len(source_lines) and _VOUCHED_RE.search(
+                    source_lines[acc.line - 1]):
+                continue
+            held = set(acc.held)
+            if not acc.in_nested_def:
+                held |= entry
+            if acc.in_nested_def and m in spawner_methods:
+                contexts = {f"thread:{m}.<inline>"}
+            else:
+                contexts = set(ctx[m])
+            pre_spawn = (acc.is_write and not acc.in_nested_def
+                         and spawn_line is not None
+                         and acc.line <= spawn_line)
+            if contexts and contexts <= {"init"} and not init_spawns:
+                pre_spawn = True    # init-only helper: happens-before
+            by_attr.setdefault(acc.attr, []).append(
+                (held, contexts, acc.is_write, pre_spawn,
+                 acc.line, acc.col, key))
+
+    findings: List[Finding] = []
+    for attr, accs in sorted(by_attr.items()):
+        live = [a for a in accs if not a[3]]      # drop pre-spawn pubs
+        writes = [a for a in live if a[2]]
+        if not writes:
+            continue
+        allctx: Set[str] = set()
+        for a in live:
+            allctx |= a[1]
+        allctx.discard("init")     # construction is not a live context
+        if len(allctx) < 2 or not any(
+                c.startswith(("thread:", "rpc:")) for c in allctx):
+            continue
+        common = None
+        for a in live:
+            common = set(a[0]) if common is None else (common & a[0])
+        if common:
+            continue
+        # GL205 already covers the all-lockless multi-writer shape in a
+        # lock-owning class — don't double-report
+        writer_methods = {a[6] for a in writes}
+        if (family.lock_attrs and len(writer_methods) >= 2
+                and not any(a[0] for a in accs)):
+            continue
+        ctx_desc = ", ".join(sorted(allctx))
+        for held, _, _, _, line, col, key in sorted(
+                writes, key=lambda a: (a[4], a[5])):
+            findings.append(Finding(
+                "GL701", relpath, line, col,
+                f"'{family.name}.{attr}' is accessed from several "
+                f"thread contexts ({ctx_desc}) with no lock common to "
+                f"all accesses", symbol=key))
+    return findings
+
+
+class ConcurrencyPass:
+    """Per-file GL701 wrapper (fixture/analyze_file entry point)."""
+
+    def run(self, relpath: str, tree: ast.Module,
+            source_lines: Sequence[str]) -> List[Finding]:
+        findings, _ = analyze_concurrency(relpath, tree, source_lines)
+        return findings
+
+
+# -- GL702: the pooled project lock-order graph -----------------------------
+
+_DOC_HEADING_RE = re.compile(
+    r"lock[- ](?:order|hierarchy)", re.I)
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`([^`]+)`\s*\|\s*`([^`]+)`\s*\|")
+
+
+def parse_lock_table(doc_text: str) -> Dict[Tuple[str, str], int]:
+    """(outer, inner) -> 1-based doc line, from the first markdown
+    table under a heading mentioning the lock order/hierarchy."""
+    rows: Dict[Tuple[str, str], int] = {}
+    in_section = False
+    for i, line in enumerate(doc_text.splitlines(), start=1):
+        if line.startswith("#"):
+            in_section = bool(_DOC_HEADING_RE.search(line))
+            continue
+        if not in_section:
+            continue
+        m = _DOC_ROW_RE.match(line.strip())
+        if m:
+            outer, inner = m.group(1).strip(), m.group(2).strip()
+            if outer.lower() in ("outer", "held lock"):
+                continue          # header row
+            rows.setdefault((outer, inner), i)
+    return rows
+
+
+def build_lock_model(facts_by_path: Dict[str, Dict]) -> Dict:
+    """Pool the per-file concurrency facts into the project model the
+    doc check, the cycle check and `tools/graftrace.py --diff` share."""
+    locks: Dict[str, Dict] = {}
+    class_locks: Dict[str, List[str]] = {}
+    factories: Dict[str, str] = {}
+    func_locks: Dict[str, Set[str]] = {}
+    func_callees: Dict[str, Set[str]] = {}
+    mf_calls: List[Tuple[Dict, str]] = []
+    for path, facts in sorted(facts_by_path.items()):
+        conc = (facts or {}).get("conc") or {}
+        for entry in conc.get("locks", ()):
+            locks.setdefault(entry["id"], dict(entry, path=path))
+            if entry.get("kind") == "class":
+                class_locks.setdefault(entry["owner"], []).append(
+                    entry["id"])
+        factories.update(conc.get("factories") or {})
+        for mf in conc.get("modfuncs", ()):
+            func_locks.setdefault(mf["name"], set()).update(
+                mf["locks"])
+            func_callees.setdefault(mf["name"], set()).update(
+                mf["callees"])
+            mf_calls.extend((dict(c), path)
+                            for c in mf.get("calls", ()))
+
+    # transitive module-lock reach per function: ``f`` calling ``g``
+    # calling ``h`` which takes a module lock means calling ``f`` can
+    # take it.  Keyed by bare name like ``factories`` — collisions
+    # across modules over-approximate, which is the safe direction.
+    func_reach: Dict[str, Set[str]] = {
+        name: set(func_locks.get(name, ()))
+        for name in set(func_locks) | set(func_callees)}
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in func_callees.items():
+            for callee in callees:
+                extra = func_reach.get(callee, set()) - func_reach[name]
+                if extra:
+                    func_reach[name] |= extra
+                    changed = True
+
+    # labeled edges: (outer, inner-label) -> first site; inner-label is
+    # an exact lock id, or "Cls.*" for a call into another lock owner
+    labeled: Dict[Tuple[str, str], Dict] = {}
+    expanded: Dict[Tuple[str, str], Tuple[str, str]] = {}
+    threads: List[Dict] = []
+    for path, facts in sorted(facts_by_path.items()):
+        conc = (facts or {}).get("conc") or {}
+        for e in conc.get("edges", ()):
+            lab = (e["outer"], e["inner"])
+            labeled.setdefault(lab, dict(e, path=path))
+            expanded.setdefault((e["outer"], e["inner"]), lab)
+        for c in conc.get("calls", ()):
+            cls = c["head"]
+            if cls not in class_locks:
+                cls = factories.get(cls, "")
+            if (cls in class_locks and c.get("kind") != "ctor"
+                    and not c["held"].startswith(f"{cls}.")):
+                lab = (c["held"], f"{cls}.*")
+                labeled.setdefault(lab, dict(c, path=path))
+                for inner in class_locks[cls]:
+                    expanded.setdefault((c["held"], inner), lab)
+            # a call into a module function that itself takes a
+            # module lock is an order edge too (DIRECT locks only:
+            # transitive reach is runtime-closure material, not a
+            # doc-table row)
+            for inner in sorted(func_locks.get(c["head"], ())):
+                if inner != c["held"]:
+                    lab = (c["held"], inner)
+                    labeled.setdefault(lab, dict(c, path=path))
+                    expanded.setdefault(lab, lab)
+        threads.extend(dict(t, path=path)
+                       for t in conc.get("threads", ()))
+    # module functions calling other functions with a module lock held
+    # (``_install_defaults`` holding obs._defaults_lock while calling
+    # spans.add_span_sink, which takes spans._sink_lock)
+    for c, path in mf_calls:
+        for inner in sorted(func_locks.get(c["head"], ())):
+            if inner != c["held"]:
+                lab = (c["held"], inner)
+                labeled.setdefault(lab, dict(c, path=path))
+                expanded.setdefault(lab, lab)
+
+    # class-call graph: which classes each family reaches (ctor calls,
+    # factory calls, bound-attr receivers), for the runtime closure
+    class_calls: Dict[str, Set[str]] = {}
+    class_callees: Dict[str, Set[str]] = {}
+    member_family: Dict[str, str] = {}
+    family_bases: Dict[str, Set[str]] = {}
+    for path, facts in sorted(facts_by_path.items()):
+        conc = (facts or {}).get("conc") or {}
+        for b in conc.get("binds", ()):
+            class_callees.setdefault(b["owner"], set()).update(
+                b["callees"])
+            tgt = class_calls.setdefault(b["owner"], set())
+            for name in b["callees"]:
+                cls = name if _class_like(name) else factories.get(
+                    name, "")
+                if cls and cls != b["owner"]:
+                    tgt.add(cls)
+        for f in conc.get("families", ()):
+            for m in f["members"]:
+                member_family.setdefault(m, f["name"])
+            family_bases.setdefault(f["name"], set()).update(
+                f.get("bases", ()))
+
+    # runtime lock ids per CONCRETE class: a subclass instance names
+    # the inherited lock after itself (``_ShardInner._lock``), so give
+    # every member its ancestors' lock attrs under its own name
+    fam_attrs: Dict[str, Set[str]] = {}
+    for fam, ids in class_locks.items():
+        fam_attrs[fam] = {i.split(".", 1)[1] for i in ids}
+
+    def _all_attrs(fam: str, seen: Set[str]) -> Set[str]:
+        if fam in seen:
+            return set()
+        seen.add(fam)
+        attrs = set(fam_attrs.get(fam, ()))
+        for base in family_bases.get(fam, ()):
+            attrs |= _all_attrs(member_family.get(base, base), seen)
+        return attrs
+
+    runtime_class_locks: Dict[str, List[str]] = {}
+    for member, fam in member_family.items():
+        attrs = _all_attrs(fam, set())
+        if attrs:
+            runtime_class_locks[member] = sorted(
+                f"{member}.{a}" for a in attrs)
+
+    return {"locks": locks, "edges": labeled, "expanded": expanded,
+            "threads": threads, "class_locks": class_locks,
+            "class_calls": {k: sorted(v)
+                            for k, v in class_calls.items()},
+            "class_callees": {k: sorted(v)
+                              for k, v in class_callees.items()},
+            "func_reach_locks": {k: sorted(v)
+                                 for k, v in func_reach.items()},
+            "modfunc_calls": [c for c, _ in mf_calls],
+            "member_family": member_family,
+            "runtime_class_locks": runtime_class_locks}
+
+
+def runtime_pairs(model: Dict) -> Set[Tuple[str, str]]:
+    """Over-approximate acquired-while-held pairs for the runtime diff.
+
+    ``model["expanded"]`` is one-hop: ``A.lock -> B.*`` says B's locks
+    can be taken while A's is held, but code running under B's methods
+    may reach C and take C's lock with A's STILL held — the runtime
+    sanitizer reports that as ``A.lock -> C.lock``.  Close every edge's
+    inner endpoint over the class-call graph so such multi-hop
+    observations don't read as model gaps.  Cycle detection and the
+    doc-table diff stay on the un-closured graph: the closure is too
+    coarse for findings (it would manufacture order edges from mere
+    reachability)."""
+    calls = model.get("class_calls", {})
+    member_family = model.get("member_family", {})
+    rt_locks = model.get("runtime_class_locks",
+                         model.get("class_locks", {}))
+    memo: Dict[str, Set[str]] = {}
+
+    def reach(cls: str) -> Set[str]:
+        if cls in memo:
+            return memo[cls]
+        seen: Set[str] = set()
+        stack = [cls]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            # call edges are keyed by FAMILY name; callees are
+            # concrete class names
+            stack.extend(calls.get(member_family.get(cur, cur), ()))
+        memo[cls] = seen
+        return seen
+
+    pairs: Set[Tuple[str, str]] = set(model["expanded"])
+    starts: Dict[str, Set[str]] = {}
+    for outer, label in model["edges"]:
+        base = (label[:-2] if label.endswith(".*")
+                else label.rsplit(".", 1)[0])
+        starts.setdefault(outer, set()).add(base)
+    for lock_id, entry in model["locks"].items():
+        # code holding a class's lock IS that class's code: anything
+        # the owner reaches (incl. local-var receivers the per-site
+        # resolution can't see) may be acquired while it is held
+        if entry.get("kind") == "class":
+            starts.setdefault(lock_id, set()).add(entry["owner"])
+    callee_names = model.get("class_callees", {})
+    func_reach = model.get("func_reach_locks", {})
+    for outer, bases in starts.items():
+        for base in bases:
+            for cls in reach(base):
+                for lock_id in rt_locks.get(cls, ()):
+                    if lock_id != outer:
+                        pairs.add((outer, lock_id))
+                # reached code may call module functions that take
+                # module-level locks (``obs.get_registry()`` on the
+                # snapshot path): their transitive reach counts too
+                fam = member_family.get(cls, cls)
+                for name in callee_names.get(fam, ()):
+                    for lock_id in func_reach.get(name, ()):
+                        if lock_id != outer:
+                            pairs.add((outer, lock_id))
+    # module-function call sites with a module lock held close over
+    # the callee's full transitive reach (the labeled edge is direct)
+    for c in model.get("modfunc_calls", ()):
+        for lock_id in func_reach.get(c["head"], ()):
+            if lock_id != c["held"]:
+                pairs.add((c["held"], lock_id))
+    return pairs
+
+
+def find_cycles(edge_pairs) -> List[List[str]]:
+    """Elementary cycles (shortest-first DFS, deduped by node set)."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edge_pairs:
+        graph.setdefault(a, []).append(b)
+    seen_sets: Set[frozenset] = set()
+    cycles: List[List[str]] = []
+
+    def dfs(start: str, node: str, path: List[str],
+            visited: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) > 1:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(list(path))
+            elif nxt not in visited and nxt > start:
+                # canonical start = smallest node: each cycle found once
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    # self-loops can't happen (emitters skip outer == inner)
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def check_lock_order(
+        facts_by_path: Dict[str, Dict],
+        doc_rel: Optional[str] = None,
+        doc_text: Optional[str] = None,
+) -> List[Tuple[Finding, str]]:
+    model = build_lock_model(facts_by_path)
+    labeled: Dict[Tuple[str, str], Dict] = model["edges"]
+    out: List[Tuple[Finding, str]] = []
+
+    for cycle in find_cycles(model["expanded"]):
+        # anchor on the first labeled site along the cycle
+        sites = []
+        ring = cycle + cycle[:1]
+        for a, b in zip(ring, ring[1:]):
+            lab = model["expanded"].get((a, b))
+            if lab and lab in labeled:
+                sites.append(labeled[lab])
+        sites.sort(key=lambda s: (s["path"], s["line"]))
+        site = sites[0] if sites else {"path": "<unknown>", "line": 1,
+                                       "srcline": "", "symbol": ""}
+        chain = " -> ".join(cycle + cycle[:1])
+        out.append((Finding(
+            "GL702", site["path"], site["line"], 0,
+            f"lock-order cycle: {chain} (deadlock when the threads "
+            f"interleave); break the cycle or merge the critical "
+            f"sections", symbol=site.get("symbol", "")),
+            site.get("srcline", "")))
+
+    if doc_text is not None and doc_rel is not None:
+        rows = parse_lock_table(doc_text)
+        doc_lines = doc_text.splitlines()
+        if not rows and labeled:
+            out.append((Finding(
+                "GL702", doc_rel, 1, 0,
+                f"{doc_rel} has no lock-order table but the package "
+                f"has {len(labeled)} acquired-while-held edge(s); add "
+                f"the canonical hierarchy section "
+                f"(tools/graftrace.py --markdown prints the rows)",
+                symbol=""), ""))
+        else:
+            for lab, site in sorted(labeled.items(),
+                                    key=lambda kv: (kv[1]["path"],
+                                                    kv[1]["line"])):
+                if lab not in rows:
+                    out.append((Finding(
+                        "GL702", site["path"], site["line"], 0,
+                        f"acquired-while-held edge {lab[0]} -> "
+                        f"{lab[1]} is missing from the lock-order "
+                        f"table in {doc_rel}", symbol=site.get(
+                            "symbol", "")), site.get("srcline", "")))
+            for (outer, inner), line in sorted(rows.items(),
+                                               key=lambda kv: kv[1]):
+                if (outer, inner) not in labeled:
+                    src = doc_lines[line - 1] if line <= len(
+                        doc_lines) else ""
+                    out.append((Finding(
+                        "GL702", doc_rel, line, 0,
+                        f"documented lock-order edge {outer} -> "
+                        f"{inner} matches no acquired-while-held site "
+                        f"in the code", symbol=""), src))
+    return out

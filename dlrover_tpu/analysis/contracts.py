@@ -1,0 +1,323 @@
+"""graftrace contract passes: fence discipline + staleness discipline.
+
+GL703 *fence discipline* — every class that writes under the master
+state dir (a ``state_dir``/``directory`` constructor param plus file
+writes: the snapshot backend, mutation log, tsdb sidecar, and any
+future writer) must consult the fence gate on its write path —
+``self.gate``/a ``gate`` parameter/``_check_fenced`` — and every
+construction site of an attribute-gated writer must wire ``.gate``.
+PRs 10/11 retrofitted the gate onto each writer by review; this rule
+makes the next state-dir artifact fenced by construction.  The per-file
+half extracts facts; :func:`check_fence` pools them cross-module
+(writers live in ``state_backend.py``/``tsdb.py``, construction sites
+in ``job_master.py``).
+
+GL704 *staleness discipline* — per file: a hot-KV key literal
+(``dcn/``/``coord/`` prefixes, the gradient-path namespace) built
+inside a function must embed an epoch/round/generation segment, or the
+function must handle the token itself (the ``_ns()`` helper pattern);
+and a function that parses a stamped plan payload
+(``json.loads(...plan_json...)``) must reference the epoch/generation
+stamp it validates against.  The PR 7 stale-restore-plan and PR 8
+stale-rejoin bugs are both instances of this rule.
+
+The hot prefixes are single-sourced in ``common/constants.py``
+(``HOT_KV_PREFIXES``); the copy here is asserted equal by
+``tests/test_graftrace.py`` so the two cannot drift (the analyzer must
+stay importable without the package's runtime deps).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dlrover_tpu.analysis.findings import Finding
+from dlrover_tpu.analysis.trace_safety import (
+    _dotted_name,
+    _import_aliases,
+)
+
+# mirror of dlrover_tpu.common.constants.HOT_KV_PREFIXES (drift-checked
+# by tests/test_graftrace.py::test_hot_prefixes_match_constants)
+HOT_KV_PREFIXES = ("dcn/", "coord/")
+
+_TOKEN_RE = re.compile(r"epoch|generation|round|token|stamp", re.I)
+_WRITE_MODE_RE = re.compile(r"[wax+]")
+_STATE_DIR_PARAM_RE = re.compile(r"state_?dir")
+_FENCED_ROOTS = ("master/", "obs/")
+
+
+def _subtree_names(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+    return out
+
+
+def _has_token(names: Set[str]) -> bool:
+    return any(_TOKEN_RE.search(n) for n in names)
+
+
+# -- GL704: per-file staleness pass -----------------------------------------
+
+class StalenessPass:
+    def run(self, relpath: str, tree: ast.Module,
+            source_lines: Sequence[str]) -> List[Finding]:
+        aliases = _import_aliases(tree)
+        findings: List[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # only OUTERMOST functions: a nested def shares its
+                # parent's token scope (closures see the epoch var)
+                node._graft_outer = True            # type: ignore
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(node):
+                if sub is not node and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    sub._graft_outer = False        # type: ignore
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and getattr(node, "_graft_outer", False):
+                findings.extend(self._check_function(
+                    relpath, node, aliases))
+        return findings
+
+    def _check_function(self, relpath: str, fn: ast.AST,
+                        aliases: Dict[str, str]) -> List[Finding]:
+        names = _subtree_names(fn)
+        has_token = _has_token(names)
+        findings: List[Finding] = []
+        in_fstring: Set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.JoinedStr):
+                for v in node.values:
+                    in_fstring.add(id(v))
+        docstrings = {id(stmt.value)
+                      for sub in ast.walk(fn)
+                      if isinstance(sub, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef, ast.Module))
+                      for stmt in sub.body[:1]
+                      if isinstance(stmt, ast.Expr)
+                      and isinstance(stmt.value, ast.Constant)}
+
+        for node in ast.walk(fn):
+            head = ""
+            namespaced = False
+            if isinstance(node, ast.JoinedStr):
+                for v in node.values:
+                    if isinstance(v, ast.Constant) and isinstance(
+                            v.value, str):
+                        head += v.value
+                    else:
+                        break
+                namespaced = any(
+                    _has_token(_subtree_names(v))
+                    for v in node.values
+                    if isinstance(v, ast.FormattedValue))
+            elif isinstance(node, ast.Constant) and isinstance(
+                    node.value, str):
+                if id(node) in in_fstring or id(node) in docstrings:
+                    continue
+                head = node.value
+            else:
+                continue
+            prefix = next((p for p in HOT_KV_PREFIXES
+                           if head.startswith(p)), None)
+            # a bare-prefix literal is a prefix CHECK (startswith),
+            # not a key — only a longer literal names an actual key
+            if prefix is None or head == prefix:
+                continue
+            if namespaced or has_token:
+                continue
+            findings.append(Finding(
+                "GL704", relpath, node.lineno, node.col_offset,
+                f"hot-KV key '{head}…' has no epoch/round/generation "
+                f"segment and the enclosing function never touches a "
+                f"staleness token — a stale payload from the previous "
+                f"world can be consumed silently",
+                symbol=getattr(fn, "name", "")))
+
+        if not has_token:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _dotted_name(node.func, aliases) != "json.loads":
+                    continue
+                if not node.args:
+                    continue
+                arg_names = _subtree_names(node.args[0])
+                if not any("plan" in n.lower() for n in arg_names):
+                    continue
+                findings.append(Finding(
+                    "GL704", relpath, node.lineno, node.col_offset,
+                    "stamped plan parsed without validating (or "
+                    "propagating) its epoch/generation token — a plan "
+                    "computed for the previous world must not commit",
+                    symbol=getattr(fn, "name", "")))
+        return findings
+
+
+# -- GL703: fence-discipline facts + pooled check ---------------------------
+
+def _is_write_open(node: ast.Call, aliases: Dict[str, str]) -> bool:
+    head = _dotted_name(node.func, aliases)
+    if head != "open":
+        return False
+    mode = None
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and bool(_WRITE_MODE_RE.search(mode))
+
+
+def extract_fence_facts(relpath: str, tree: ast.Module,
+                        source_lines: Sequence[str]) -> Dict:
+    """Per-file facts for the pooled GL703 checker."""
+    aliases = _import_aliases(tree)
+
+    def _src(line: int) -> str:
+        if 1 <= line <= len(source_lines):
+            return source_lines[line - 1]
+        return ""
+
+    writers: List[Dict] = []
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        init = next((m for m in node.body
+                     if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        if init is None:
+            continue
+        params = [a.arg for a in init.args.args[1:]]
+        has_state_dir = any(_STATE_DIR_PARAM_RE.search(p)
+                            for p in params)
+        if not has_state_dir and relpath.startswith(_FENCED_ROOTS):
+            has_state_dir = "directory" in params
+        if not has_state_dir:
+            continue
+        write_sites: List[Dict] = []
+        consults = False
+        gate_attr = False
+        for meth in node.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            names = _subtree_names(meth)
+            if "gate" in names or "_check_fenced" in names:
+                consults = True
+            for sub in ast.walk(meth):
+                if meth.name == "__init__":
+                    if (isinstance(sub, ast.Attribute)
+                            and sub.attr == "gate"
+                            and isinstance(sub.ctx, ast.Store)):
+                        gate_attr = True
+                    continue
+                if isinstance(sub, ast.Call):
+                    head = _dotted_name(sub.func, aliases)
+                    if head in ("os.replace", "os.rename") or \
+                            _is_write_open(sub, aliases):
+                        write_sites.append({
+                            "line": sub.lineno, "col": sub.col_offset,
+                            "srcline": _src(sub.lineno),
+                            "symbol": f"{node.name}.{meth.name}"})
+        if write_sites:
+            # ast.walk is breadth-first: sort so the finding anchors
+            # at the FIRST write site in source order
+            write_sites.sort(key=lambda s: (s["line"], s["col"]))
+            writers.append({"cls": node.name,
+                            "write_sites": write_sites,
+                            "consults_gate": consults,
+                            "gate_attr": gate_attr,
+                            "gate_param": "gate" in params})
+
+    ctors: List[Dict] = []
+    gate_wired: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and tgt.attr == "gate":
+                    base = tgt.value
+                    if isinstance(base, ast.Attribute) and isinstance(
+                            base.value, ast.Name):
+                        gate_wired.add(f"{base.value.id}.{base.attr}")
+                    elif isinstance(base, ast.Name):
+                        gate_wired.add(base.id)
+            if isinstance(node.value, ast.Call):
+                head = _dotted_name(node.value.func, aliases) or ""
+                cls = head.rsplit(".", 1)[-1]
+                arg_names: Set[str] = set()
+                for arg in node.value.args:
+                    arg_names |= _subtree_names(arg)
+                for kw in node.value.keywords:
+                    arg_names |= _subtree_names(kw.value)
+                # only dir-taking constructions can be state-dir
+                # writers — keeps the pooled fact payload small
+                dir_arg = any("dir" in n.lower() for n in arg_names)
+                if cls[:1].isupper() and dir_arg:
+                    bound = ""
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Attribute) and isinstance(
+                            tgt.value, ast.Name):
+                        bound = f"{tgt.value.id}.{tgt.attr}"
+                    elif isinstance(tgt, ast.Name):
+                        bound = tgt.id
+                    has_gate_kwarg = any(
+                        kw.arg == "gate" for kw in node.value.keywords)
+                    ctors.append({"cls": cls, "bound": bound,
+                                  "line": node.value.lineno,
+                                  "col": node.value.col_offset,
+                                  "srcline": _src(node.value.lineno),
+                                  "gate_kwarg": has_gate_kwarg})
+
+    if not writers and not ctors:
+        return {}
+    return {"writers": writers, "ctors": ctors,
+            "gate_wired": sorted(gate_wired)}
+
+
+def check_fence(
+        facts_by_path: Dict[str, Dict]) -> List[Tuple[Finding, str]]:
+    out: List[Tuple[Finding, str]] = []
+    attr_gated: Set[str] = set()
+    for path, facts in sorted(facts_by_path.items()):
+        fence = (facts or {}).get("fence") or {}
+        for w in fence.get("writers", ()):
+            if w["consults_gate"]:
+                if w.get("gate_attr"):
+                    attr_gated.add(w["cls"])
+                continue
+            site = w["write_sites"][0]
+            out.append((Finding(
+                "GL703", path, site["line"], site["col"],
+                f"state-dir writer {w['cls']} never consults the fence "
+                f"gate on its write path — a deposed master keeps "
+                f"writing over the promoted one's state (wire a "
+                f"`gate` callable like MutationLog/TsdbCollector do)",
+                symbol=site["symbol"]), site["srcline"]))
+    for path, facts in sorted(facts_by_path.items()):
+        fence = (facts or {}).get("fence") or {}
+        wired = set(fence.get("gate_wired", ()))
+        for c in fence.get("ctors", ()):
+            if c["cls"] not in attr_gated:
+                continue
+            if c.get("gate_kwarg") or c["bound"] in wired:
+                continue
+            out.append((Finding(
+                "GL703", path, c["line"], c["col"],
+                f"{c['cls']} is constructed here but its fence gate is "
+                f"never wired ({c['bound'] or 'the instance'}.gate "
+                f"stays None) — the writer runs unfenced",
+                symbol=""), c["srcline"]))
+    return out

@@ -164,6 +164,45 @@ RULES: Dict[str, Rule] = {
             "section (the PR 10 mutation-log lesson).",
         ),
         Rule(
+            "GL701", "thread-roster",
+            "cross-thread access without a common lock",
+            "the thread roster (Thread/Timer/executor targets + RPC "
+            "servicer entry points) reaches this attribute from more "
+            "than one thread context and no lock is common to all its "
+            "accesses — guard every access with one lock, publish via "
+            "a threading.Event, or assign only before the thread "
+            "starts.",
+        ),
+        Rule(
+            "GL702", "lock-order",
+            "lock-order cycle or hierarchy-table drift",
+            "the project-wide acquired-while-held graph (lexical "
+            "nesting + lock-held helpers + calls into other lock "
+            "owners) must stay acyclic AND match the canonical table "
+            "in docs/fault_tolerance.md — break the cycle or update "
+            "the table (tools/graftrace.py --markdown regenerates the "
+            "rows).",
+        ),
+        Rule(
+            "GL703", "fence-discipline",
+            "master state-dir writer bypasses the fence gate",
+            "every writer under the master state dir must consult the "
+            "fence gate on its write path (`self.gate`/`gate` "
+            "callable, PR 10's `_check_fenced`), and every "
+            "construction site must wire the gate — a deposed master "
+            "that keeps writing corrupts the promoted master's state.",
+        ),
+        Rule(
+            "GL704", "staleness-discipline",
+            "hot-KV key or stamped plan consumed without its token",
+            "hot-prefix KV keys (dcn/, coord/) must embed an epoch/"
+            "round/generation segment (or be built by a helper that "
+            "namespaces them), and a parsed plan payload must be "
+            "validated against its epoch/generation stamp before "
+            "commit — a stale payload from the previous world silently "
+            "corrupts the new one.",
+        ),
+        Rule(
             "GL601", "obs-drift",
             "documented observability name not emitted by code",
             "docs/observability.md catalogs a metric/span/flight-event "

@@ -395,6 +395,43 @@ class _ClassFamily:
         return bool(self.lock_attrs)
 
 
+def entry_locksets(
+        scans: Dict[str, _MethodScan]) -> Dict[str, frozenset]:
+    """Fixpoint: a private method's entry lockset is the intersection
+    of held locksets at its internal call sites. Shared with the
+    graftrace concurrency pass, which joins the same "(lock held)"
+    helper propagation into its project-wide lock-order graph."""
+    sites: Dict[str, List[_CallSite]] = {}
+    for scan in scans.values():
+        for cs in scan.calls:
+            sites.setdefault(cs.callee, []).append(cs)
+
+    memo: Dict[str, frozenset] = {}
+
+    def entry(meth: str, stack: Set[str]) -> frozenset:
+        if meth in memo:
+            return memo[meth]
+        if not meth.startswith("_") or meth.startswith("__"):
+            memo[meth] = frozenset()
+            return memo[meth]
+        call_sites = sites.get(meth)
+        if not call_sites:
+            memo[meth] = frozenset()
+            return memo[meth]
+        if meth in stack:
+            return frozenset()   # cycle: no caller contribution
+        acc: Optional[frozenset] = None
+        for cs in call_sites:
+            held = frozenset(cs.held) | entry(cs.caller,
+                                              stack | {meth})
+            acc = held if acc is None else (acc & held)
+        memo[meth] = acc or frozenset()
+        return memo[meth]
+
+    return {m: entry(m, set())
+            for m in {s.split(".", 1)[1] for s in scans}}
+
+
 class LockDisciplinePass:
     def run(self, relpath: str, tree: ast.Module,
             source_lines: Sequence[str]) -> List[Finding]:
@@ -531,37 +568,7 @@ class LockDisciplinePass:
     def _entry_locksets(
             self, family: _ClassFamily,
             scans: Dict[str, _MethodScan]) -> Dict[str, frozenset]:
-        """Fixpoint: a private method's entry lockset is the intersection
-        of held locksets at its internal call sites."""
-        sites: Dict[str, List[_CallSite]] = {}
-        for scan in scans.values():
-            for cs in scan.calls:
-                sites.setdefault(cs.callee, []).append(cs)
-
-        memo: Dict[str, frozenset] = {}
-
-        def entry(meth: str, stack: Set[str]) -> frozenset:
-            if meth in memo:
-                return memo[meth]
-            if not meth.startswith("_") or meth.startswith("__"):
-                memo[meth] = frozenset()
-                return memo[meth]
-            call_sites = sites.get(meth)
-            if not call_sites:
-                memo[meth] = frozenset()
-                return memo[meth]
-            if meth in stack:
-                return frozenset()   # cycle: no caller contribution
-            acc: Optional[frozenset] = None
-            for cs in call_sites:
-                held = frozenset(cs.held) | entry(cs.caller,
-                                                  stack | {meth})
-                acc = held if acc is None else (acc & held)
-            memo[meth] = acc or frozenset()
-            return memo[meth]
-
-        return {m: entry(m, set())
-                for m in {s.split(".", 1)[1] for s in scans}}
+        return entry_locksets(scans)
 
     def _infer_guards(self, family: _ClassFamily,
                       accesses: List[_Access]) -> List[Finding]:

@@ -1,0 +1,487 @@
+"""graftrace runtime lock sanitizer — the dynamic half of GL702/GL501.
+
+Env-gated (``DLROVER_TPU_LOCKCHECK=1`` via the tests/conftest.py
+session fixture, or explicitly through ``tools/graftrace.py --run``):
+``install()`` replaces ``threading.Lock``/``threading.RLock`` with a
+tracing proxy for locks *created by this package's code* (creation-
+frame filename filter), plus thin wrappers over the blocking vocab
+(sleep / fsync / replace / open / connect) that record any blocking
+call made while a traced lock is held.
+
+What it records, per process:
+
+- the **observed acquisition-order graph**: for every successful
+  acquire, one edge from each lock the thread already holds to the new
+  one (first sample site kept per edge);
+- **hold times** per lock (count / max / total) — the "longest hold"
+  table in the report;
+- **blocking-under-lock events**, classified *hot* when the held lock
+  belongs to a gradient-path owner (the same
+  ``lock_discipline._HOT_CLASS_NAMES`` / dcn_sync roster GL5xx uses).
+
+``report()`` resolves lock names lazily by scanning live objects for
+the attribute holding each proxy (``Cls.attr``, matching the static
+GL702 lock ids; a ``threading.Condition`` is traced through its inner
+lock and resolves to the condition's own attribute name), detects
+cycles with the same ``find_cycles`` the static pass uses, and returns
+a JSON-able dict.  ``tools/graftrace.py`` diffs the observed graph
+against the static model both directions: an observed edge the static
+model lacks is a *model gap* (fail); a modeled edge never observed is
+a *coverage gap* (report only).
+
+Caveats (by design): locks created before ``install()`` — e.g. module
+import-time singletons — are invisible; locks never resolved to an
+attribute show as ``file.py:line`` and are excluded from the static
+diff (the static model has no name for them either).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+ENV_FLAG = "DLROVER_TPU_LOCKCHECK"
+ENV_OUT = "DLROVER_TPU_LOCKCHECK_OUT"
+DEFAULT_OUT = "/tmp/graftrace_lockcheck.json"
+
+# mirror the static hot roster (lock_discipline) without importing the
+# analyzer into the runtime path
+_HOT_OWNERS = {"KVStoreService", "MutationLog", "SliceGradSync",
+               "StepTimeline"}
+_HOT_FILE_SUFFIXES = ("parallel/dcn_sync.py",)
+
+_perf = time.perf_counter
+
+
+class _Held:
+    __slots__ = ("proxy", "t0", "depth")
+
+    def __init__(self, proxy: "_TracedLock", t0: float):
+        self.proxy = proxy
+        self.t0 = t0
+        self.depth = 1
+
+
+class _State:
+    """One sanitizer session (module-global singleton while installed)."""
+
+    def __init__(self) -> None:
+        # the sanitizer's own lock must be a REAL lock (allocated from
+        # the saved original), or tracing would recurse into itself
+        self.mutex = _ORIG["lock"]()
+        self.tls = threading.local()
+        self.locks: List["_TracedLock"] = []
+        # (id(outer), id(inner)) -> first sample {site, thread}
+        self.edges: Dict[Tuple[int, int], Dict] = {}
+        self.blocking: List[Dict] = []
+
+    def stack(self) -> List[_Held]:
+        st = getattr(self.tls, "stack", None)
+        if st is None:
+            st = []
+            self.tls.stack = st
+        return st
+
+
+_ORIG: Dict[str, Any] = {}
+_state: Optional[_State] = None
+_trace_roots: Tuple[str, ...] = ()
+
+
+def _caller_site(depth: int = 2) -> str:
+    """First frame outside this module (``with lock:`` adds an
+    ``__enter__`` hop, so a fixed depth under-shoots)."""
+    try:
+        frame = sys._getframe(depth)
+        while frame is not None and \
+                frame.f_code.co_filename == __file__:
+            frame = frame.f_back
+        if frame is None:
+            return "<unknown>"
+        return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+    except (ValueError, AttributeError):
+        return "<unknown>"
+
+
+def _is_traced_frame(depth: int = 2) -> bool:
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:
+        return False
+    filename = frame.f_code.co_filename
+    return filename.startswith(_trace_roots)
+
+
+class _TracedLock:
+    """Proxy over a real Lock/RLock recording order/hold/blocking facts.
+
+    Implements the private Condition protocol (``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned``) with stack bookkeeping so a
+    ``Condition.wait`` — which fully releases the lock — does not leave
+    phantom held entries behind."""
+
+    def __init__(self, real, site: str):
+        self._real = real
+        self._site = site
+        self._name: Optional[str] = None
+        self._acquisitions = 0
+        self._max_hold = 0.0
+        self._total_hold = 0.0
+
+    # -- bookkeeping -----------------------------------------------------
+    def _push(self) -> None:
+        st = _state.stack()
+        for held in st:
+            if held.proxy is self:
+                held.depth += 1          # reentrant RLock acquire
+                return
+        site = _caller_site(3)
+        thread = threading.current_thread().name
+        with _state.mutex:
+            self._acquisitions += 1
+            for held in st:
+                _state.edges.setdefault(
+                    (id(held.proxy), id(self)),
+                    {"site": site, "thread": thread})
+        st.append(_Held(self, _perf()))
+
+    def _pop(self) -> None:
+        st = _state.stack()
+        for i in range(len(st) - 1, -1, -1):
+            held = st[i]
+            if held.proxy is self:
+                if held.depth > 1:
+                    held.depth -= 1
+                    return
+                del st[i]
+                dur = _perf() - held.t0
+                with _state.mutex:
+                    self._total_hold += dur
+                    if dur > self._max_hold:
+                        self._max_hold = dur
+                return
+
+    # -- lock protocol ---------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._real.acquire(blocking, timeout)
+        if ok:
+            self._push()
+        return ok
+
+    def release(self) -> None:
+        self._pop()
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    # -- Condition protocol ----------------------------------------------
+    def _release_save(self):
+        # wait() drops the lock wholesale, whatever the RLock depth
+        st = _state.stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i].proxy is self:
+                held = st[i]
+                del st[i]
+                dur = _perf() - held.t0
+                with _state.mutex:
+                    self._total_hold += dur
+                    if dur > self._max_hold:
+                        self._max_hold = dur
+                break
+        if hasattr(self._real, "_release_save"):
+            return self._real._release_save()
+        self._real.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        if hasattr(self._real, "_acquire_restore"):
+            self._real._acquire_restore(state)
+        else:
+            self._real.acquire()
+        self._push()
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._real, "_is_owned"):
+            return self._real._is_owned()
+        # plain Lock heuristic (Condition over Lock): owned if held in
+        # this thread's traced stack
+        return any(h.proxy is self for h in _state.stack())
+
+    def __repr__(self) -> str:
+        return f"<_TracedLock {self._name or self._site} {self._real!r}>"
+
+
+def _make_factory(kind: str):
+    real_factory = _ORIG[kind]
+
+    def factory(*args, **kwargs):
+        real = real_factory(*args, **kwargs)
+        if _state is None or not _is_traced_frame(2):
+            return real
+        proxy = _TracedLock(real, _caller_site(2))
+        with _state.mutex:
+            _state.locks.append(proxy)
+        return proxy
+
+    return factory
+
+
+def _make_blocking_wrapper(name: str, real):
+    def wrapper(*args, **kwargs):
+        st = getattr(_state.tls, "stack", None) if _state else None
+        if not st:
+            return real(*args, **kwargs)
+        t0 = _perf()
+        try:
+            return real(*args, **kwargs)
+        finally:
+            dur = _perf() - t0
+            event = {
+                "func": name,
+                "duration_s": round(dur, 6),
+                "held": [id(h.proxy) for h in st],
+                "site": _caller_site(2),
+                "thread": threading.current_thread().name,
+            }
+            with _state.mutex:
+                _state.blocking.append(event)
+
+    return wrapper
+
+
+_BLOCKING_PATCHES = (
+    ("time", "sleep"),
+    ("os", "fsync"),
+    ("os", "replace"),
+    ("builtins", "open"),
+    ("socket", "create_connection"),
+)
+
+
+def install(package_dir: Optional[str] = None,
+            extra_paths: Tuple[str, ...] = ()) -> None:
+    """Start tracing.  ``package_dir`` defaults to the dlrover_tpu
+    package; only locks created from files under it (or
+    ``extra_paths``) are proxied."""
+    global _state, _trace_roots
+    if _state is not None:
+        return
+    if package_dir is None:
+        import dlrover_tpu
+        package_dir = os.path.dirname(os.path.abspath(
+            dlrover_tpu.__file__))
+    _trace_roots = tuple(os.path.abspath(p)
+                         for p in (package_dir,) + tuple(extra_paths))
+    _ORIG["lock"] = threading.Lock
+    _ORIG["rlock"] = threading.RLock
+    _state = _State()
+    threading.Lock = _make_factory("lock")
+    threading.RLock = _make_factory("rlock")
+    import builtins
+    import socket
+    modules = {"time": time, "os": os, "builtins": builtins,
+               "socket": socket}
+    for mod_name, attr in _BLOCKING_PATCHES:
+        mod = modules[mod_name]
+        real = getattr(mod, attr)
+        _ORIG[f"{mod_name}.{attr}"] = real
+        setattr(mod, attr, _make_blocking_wrapper(
+            f"{mod_name}.{attr}", real))
+
+
+def uninstall() -> None:
+    """Stop tracing and restore every patched callable (the collected
+    state survives for a final ``report()``)."""
+    global _state, _trace_roots
+    if _state is None:
+        return
+    threading.Lock = _ORIG["lock"]
+    threading.RLock = _ORIG["rlock"]
+    import builtins
+    import socket
+    modules = {"time": time, "os": os, "builtins": builtins,
+               "socket": socket}
+    for mod_name, attr in _BLOCKING_PATCHES:
+        setattr(modules[mod_name], attr, _ORIG[f"{mod_name}.{attr}"])
+    _trace_roots = ()
+    # keep _state for report-after-uninstall; installed-ness is tracked
+    # by the patched factories, which are gone now
+
+
+def installed() -> bool:
+    return _state is not None and threading.Lock is not _ORIG.get("lock")
+
+
+def _resolve_names() -> None:
+    """Best-effort lock naming: find the attribute each proxy (or the
+    Condition wrapping it) lives under, yielding the static model's
+    ``Cls.attr`` / ``module.attr`` ids."""
+    import gc
+
+    by_id = {id(p): p for p in _state.locks if p._name is None}
+    if not by_id:
+        return
+    for obj in gc.get_objects():
+        if isinstance(obj, (_TracedLock, dict, list, tuple)):
+            continue
+        try:
+            d = getattr(obj, "__dict__", None)
+        except Exception:  # noqa: BLE001 — exotic descriptors
+            continue
+        if not isinstance(d, dict):
+            continue
+        if isinstance(obj, type(sys)):                 # a module
+            try:
+                owner = obj.__name__.rsplit(".", 1)[-1]
+            except Exception:  # noqa: BLE001 — lazy-loader module
+                continue       # shims (TF/Keras) raise on __name__
+        else:
+            owner = type(obj).__name__
+        for attr, val in list(d.items()):
+            target = None
+            if isinstance(val, _TracedLock):
+                target = val
+            elif isinstance(val, threading.Condition) and isinstance(
+                    getattr(val, "_lock", None), _TracedLock):
+                target = val._lock
+            if target is not None and id(target) in by_id \
+                    and target._name is None:
+                target._name = f"{owner}.{attr}"
+        if not any(p._name is None for p in by_id.values()):
+            break
+
+
+def _fallback_name(proxy: "_TracedLock") -> str:
+    site = proxy._site
+    return os.path.basename(site.rsplit(":", 1)[0]) + ":" + \
+        site.rsplit(":", 1)[-1]
+
+
+def _lock_name(proxy: "_TracedLock") -> str:
+    return proxy._name or _fallback_name(proxy)
+
+
+def _is_hot(proxy: "_TracedLock") -> bool:
+    name = proxy._name or ""
+    owner = name.split(".", 1)[0] if "." in name else ""
+    if owner in _HOT_OWNERS:
+        return True
+    created = proxy._site.rsplit(":", 1)[0]
+    return created.endswith(_HOT_FILE_SUFFIXES)
+
+
+def report() -> Dict:
+    """Resolve names, aggregate instance-level facts to name level, and
+    return the flight-style dict ``tools/graftrace.py`` consumes."""
+    from dlrover_tpu.analysis.concurrency import find_cycles
+
+    if _state is None:
+        return {"enabled": False, "locks": [], "edges": [],
+                "cycles": [], "hot_blocking": [], "blocking": []}
+    with _state.mutex:
+        locks = list(_state.locks)
+        edges = dict(_state.edges)
+        blocking = list(_state.blocking)
+    _resolve_names()
+    by_id = {id(p): p for p in locks}
+
+    lock_rows = []
+    for p in sorted(locks, key=_lock_name):
+        lock_rows.append({
+            "name": _lock_name(p), "resolved": p._name is not None,
+            "site": p._site, "hot": _is_hot(p),
+            "acquisitions": p._acquisitions,
+            "max_hold_s": round(p._max_hold, 6),
+            "total_hold_s": round(p._total_hold, 6),
+        })
+
+    # aggregate by name: several instances of one class share an id
+    named_edges: Dict[Tuple[str, str], Dict] = {}
+    for (outer_id, inner_id), sample in edges.items():
+        outer = by_id.get(outer_id)
+        inner = by_id.get(inner_id)
+        if outer is None or inner is None:
+            continue
+        key = (_lock_name(outer), _lock_name(inner))
+        if key[0] == key[1]:
+            continue            # same-name reentrancy across instances
+        entry = named_edges.setdefault(key, dict(
+            sample, outer=key[0], inner=key[1],
+            resolved=(outer._name is not None
+                      and inner._name is not None)))
+        entry["resolved"] = entry["resolved"] or (
+            outer._name is not None and inner._name is not None)
+    edge_rows = [named_edges[k] for k in sorted(named_edges)]
+
+    cycles = find_cycles(list(named_edges))
+
+    blocking_rows = []
+    hot_rows = []
+    for ev in blocking:
+        held = [by_id[h] for h in ev["held"] if h in by_id]
+        row = dict(ev, held=[_lock_name(p) for p in held])
+        blocking_rows.append(row)
+        hot_held = [_lock_name(p) for p in held if _is_hot(p)]
+        if hot_held:
+            hot_rows.append(dict(row, hot_held=hot_held))
+
+    return {
+        "enabled": True,
+        "locks": lock_rows,
+        "edges": edge_rows,
+        "cycles": cycles,
+        "blocking": blocking_rows,
+        "hot_blocking": hot_rows,
+    }
+
+
+def observed_static_diff(rep: Dict, static_pairs,
+                         coverage_pairs=None) -> Dict:
+    """Two-way diff: observed edges with both endpoints resolved that
+    the static model lacks (model gap → the static pass is blind to a
+    real nesting: FAIL), and static edges never observed (coverage gap
+    → report only).
+
+    ``static_pairs`` is the over-approximate set the model-gap
+    direction checks against (``concurrency.runtime_pairs``: one-hop
+    edges closed over the class-call graph).  ``coverage_pairs``, when
+    given, is the tighter set the coverage direction reports on
+    (``model["expanded"]``) — diffing coverage against the closure
+    would drown the report in never-acquirable pairs."""
+    static = {tuple(p) for p in static_pairs}
+    coverage = static if coverage_pairs is None else {
+        tuple(p) for p in coverage_pairs}
+    observed = {(e["outer"], e["inner"]) for e in rep.get("edges", ())
+                if e.get("resolved")}
+    unresolved = [(e["outer"], e["inner"])
+                  for e in rep.get("edges", ()) if not e.get("resolved")]
+    return {
+        "observed_not_modeled": sorted(observed - static),
+        "modeled_not_observed": sorted(coverage - observed),
+        "unresolved_observed": sorted(unresolved),
+    }
+
+
+def reset() -> None:
+    """Drop collected state (between gate phases in one process)."""
+    global _state
+    if _state is None:
+        return
+    was_installed = installed()
+    if was_installed:
+        uninstall()
+    _state = None
+    if was_installed:
+        install()

@@ -28,6 +28,15 @@ import os
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from dlrover_tpu.analysis.concurrency import (
+    analyze_concurrency,
+    check_lock_order,
+)
+from dlrover_tpu.analysis.contracts import (
+    StalenessPass,
+    check_fence,
+    extract_fence_facts,
+)
 from dlrover_tpu.analysis.findings import (
     Finding,
     apply_pragmas,
@@ -50,7 +59,15 @@ from dlrover_tpu.analysis.state_roundtrip import StateRoundtripPass
 from dlrover_tpu.analysis.trace_safety import TraceSafetyPass
 
 BASELINE_VERSION = 2
-CACHE_VERSION = 1
+# 2: concurrency facts grew binds/families and entry-lockset call
+# edges — facts cached by v1 would silently miss lock-order edges
+# 3: calls facts carry a ctor/call kind tag and module-function lock
+# facts (modfuncs) joined the schema
+CACHE_VERSION = 3
+# a cold run fans misses out over a process pool only past this count:
+# below it the fork+import cost exceeds the analysis itself, and the
+# deterministic sequential path keeps single-file runs trivially simple
+PARALLEL_MIN_FILES = 8
 
 
 @dataclasses.dataclass
@@ -114,10 +131,18 @@ def _analyze_source(path: str, relpath: str,
     findings.extend(TraceSafetyPass().run(relpath, tree, lines))
     findings.extend(LockDisciplinePass().run(relpath, tree, lines))
     findings.extend(StateRoundtripPass().run(relpath, tree, lines))
+    findings.extend(StalenessPass().run(relpath, tree, lines))
+    conc_findings, conc_facts = analyze_concurrency(relpath, tree, lines)
+    findings.extend(conc_findings)
     facts = extract_protocol_facts(relpath, tree, lines)
     obs_facts = extract_obs_facts(relpath, tree, lines)
     if obs_facts:
         facts["obs"] = obs_facts
+    if conc_facts:
+        facts["conc"] = conc_facts
+    fence_facts = extract_fence_facts(relpath, tree, lines)
+    if fence_facts:
+        facts["fence"] = fence_facts
     pragmas = {str(k): sorted(v)
                for k, v in line_pragmas(lines).items()}
     return apply_pragmas(findings, lines), facts, pragmas
@@ -190,10 +215,61 @@ def _doc_relpath(doc_path: str) -> str:
     return "/".join(parts[-2:])
 
 
+def _analyze_one(task: Tuple[str, str]) -> Tuple[str, Optional[Dict],
+                                                 Optional[str]]:
+    """Pool-safe per-file worker: (abspath, relpath) -> (abspath,
+    serialized payload, error). Everything in the payload is
+    JSON-shaped so the fork pool can pickle it and the cache can store
+    it verbatim."""
+    abspath, relpath = task
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            source = f.read()
+        findings, facts, pragmas = _analyze_source(
+            abspath, relpath, source)
+    except (SyntaxError, ValueError, UnicodeDecodeError,
+            OSError) as e:
+        # SyntaxError from ast.parse; ValueError for NUL bytes;
+        # UnicodeDecodeError for non-UTF8 sources; OSError for
+        # unreadable files
+        return abspath, None, f"{relpath}: {e}"
+    lines = source.splitlines()
+    payload = {
+        "findings": [
+            dict(_finding_to_dict(fnd),
+                 srcline=source_line(lines, fnd.line))
+            for fnd in findings],
+        "facts": facts,
+        "pragmas": pragmas,
+    }
+    return abspath, payload, None
+
+
+def _analyze_many(tasks: List[Tuple[str, str]],
+                  jobs: int) -> List[Tuple[str, Optional[Dict],
+                                           Optional[str]]]:
+    """Run the per-file worker over every miss — through a fork pool
+    when the batch is big enough, sequentially otherwise. Results come
+    back in task order either way, so cache contents, fingerprints and
+    parse-error ordering are identical across both paths."""
+    if jobs > 1 and len(tasks) >= PARALLEL_MIN_FILES:
+        try:
+            import multiprocessing
+
+            ctx = multiprocessing.get_context("fork")
+            with ctx.Pool(processes=min(jobs, len(tasks))) as pool:
+                return pool.map(_analyze_one, tasks)
+        except (ImportError, OSError, ValueError):
+            pass          # no fork on this platform: sequential path
+    return [_analyze_one(t) for t in tasks]
+
+
 def run_analysis(roots: Sequence[str],
                  baseline: Optional[Dict] = None,
                  cache_path: Optional[str] = None,
-                 obs_doc: Optional[str] = None) -> AnalysisResult:
+                 obs_doc: Optional[str] = None,
+                 lock_doc: Optional[str] = None,
+                 jobs: int = 1) -> AnalysisResult:
     started = time.monotonic()
     per_path: Dict[str, List[Tuple[Finding, str]]] = {}
     facts_by_path: Dict[str, Dict] = {}
@@ -202,82 +278,99 @@ def run_analysis(roots: Sequence[str],
     parse_errors: List[str] = []
     analyzed: List[str] = []
     seen_paths: set = set()
-    files = 0
     hits = misses = 0
 
     cache = load_cache(cache_path) if cache_path else {}
     cache_out: Dict = dict(cache)
 
+    # pass 1: enumerate + cache probe, collecting the miss list so a
+    # cold run can fan it out across a process pool (the warm fast
+    # path — all hits — never touches the pool)
+    entries: List[Tuple[str, str, Optional[List[int]],
+                        Optional[Dict]]] = []
+    to_analyze: List[Tuple[str, str]] = []
     for root in roots:
         for path, relpath in iter_python_files(root):
             abspath = os.path.abspath(path)
             if abspath in seen_paths:
                 continue      # overlapping roots: analyze each file once
             seen_paths.add(abspath)
-            files += 1
             key = _file_key(abspath)
             entry = cache.get(abspath)
-            if (entry is not None and key is not None
+            if not (entry is not None and key is not None
                     and entry.get("key") == key
                     and entry.get("relpath") == relpath):
-                hits += 1
-                found = [(_finding_from_dict(d), d.get("srcline", ""))
-                         for d in entry.get("findings", [])]
-                facts = entry.get("facts") or {}
-                pragmas = entry.get("pragmas") or {}
-            else:
-                misses += 1
-                try:
-                    with open(abspath, encoding="utf-8") as f:
-                        source = f.read()
-                    findings, facts, pragmas = _analyze_source(
-                        abspath, relpath, source)
-                except (SyntaxError, ValueError, UnicodeDecodeError,
-                        OSError) as e:
-                    # SyntaxError from ast.parse; ValueError for NUL
-                    # bytes; UnicodeDecodeError for non-UTF8 sources;
-                    # OSError for unreadable files. NOT recorded as
-                    # analyzed: a file that failed to parse must keep
-                    # its baseline entries (write_baseline drops
-                    # entries only for re-analyzed files)
-                    parse_errors.append(f"{relpath}: {e}")
-                    cache_out.pop(abspath, None)
-                    continue
-                lines = source.splitlines()
-                found = [(fnd, source_line(lines, fnd.line))
-                         for fnd in findings]
-                if key is not None:
-                    cache_out[abspath] = {
-                        "key": key, "relpath": relpath,
-                        "findings": [
-                            dict(_finding_to_dict(fnd),
-                                 srcline=srcline)
-                            for fnd, srcline in found],
-                        "facts": facts,
-                        "pragmas": pragmas,
-                    }
-            analyzed.append(relpath)
-            # distinct files can share a package-relative path when the
-            # analyzed roots span several packages (the real package +
-            # a fixture package): FACTS keep a unique key so the
-            # cross-module checkers never diff a chimera of two
-            # unrelated modules, while findings group by the REAL
-            # relpath — colliding files share one occurrence-suffix
-            # group, so textually identical findings still get
-            # distinct fingerprints
-            key_path = relpath
-            suffix = 2
-            while key_path in facts_by_path:
-                key_path = f"{relpath}#{suffix}"
-                suffix += 1
-            display_path[key_path] = relpath
-            facts_by_path[key_path] = facts
-            pragmas_by_path[key_path] = pragmas
-            per_path.setdefault(relpath, []).extend(found)
+                entry = None
+                to_analyze.append((abspath, relpath))
+            entries.append((abspath, relpath, key, entry))
+    files = len(entries)
+    fresh: Dict[str, Tuple[Optional[Dict], Optional[str]]] = {
+        abspath: (payload, err)
+        for abspath, payload, err in _analyze_many(to_analyze, jobs)}
+
+    for abspath, relpath, key, entry in entries:
+        if entry is not None:
+            hits += 1
+            payload = entry
+        else:
+            misses += 1
+            payload, err = fresh[abspath]
+            if payload is None:
+                # NOT recorded as analyzed: a file that failed to
+                # parse must keep its baseline entries
+                # (write_baseline drops entries only for re-analyzed
+                # files)
+                parse_errors.append(err or f"{relpath}: unknown error")
+                cache_out.pop(abspath, None)
+                continue
+            if key is not None:
+                cache_out[abspath] = dict(payload, key=key,
+                                          relpath=relpath)
+        found = [(_finding_from_dict(d), d.get("srcline", ""))
+                 for d in payload.get("findings", [])]
+        facts = payload.get("facts") or {}
+        pragmas = payload.get("pragmas") or {}
+        analyzed.append(relpath)
+        # distinct files can share a package-relative path when the
+        # analyzed roots span several packages (the real package +
+        # a fixture package): FACTS keep a unique key so the
+        # cross-module checkers never diff a chimera of two
+        # unrelated modules, while findings group by the REAL
+        # relpath — colliding files share one occurrence-suffix
+        # group, so textually identical findings still get
+        # distinct fingerprints
+        key_path = relpath
+        suffix = 2
+        while key_path in facts_by_path:
+            key_path = f"{relpath}#{suffix}"
+            suffix += 1
+        display_path[key_path] = relpath
+        facts_by_path[key_path] = facts
+        pragmas_by_path[key_path] = pragmas
+        per_path.setdefault(relpath, []).extend(found)
 
     # -- cross-module checkers over the pooled facts ---------------------
     cross: List[Tuple[Finding, str]] = list(
         check_protocol(facts_by_path))
+    cross.extend(check_fence(facts_by_path))
+    lock_doc_rel = lock_doc_text = None
+    if lock_doc:
+        lock_doc_rel = _doc_relpath(lock_doc)
+        try:
+            with open(lock_doc, encoding="utf-8") as f:
+                lock_doc_text = f.read()
+        except OSError as e:
+            # same discipline as the obs catalog: a missing hierarchy
+            # table must FAIL the run, not silently skip GL702's
+            # doc-contract half
+            parse_errors.append(f"{lock_doc_rel}: lock-order table "
+                                f"unreadable ({e})")
+            lock_doc_rel = lock_doc_text = None
+        else:
+            analyzed.append(lock_doc_rel)
+    # cycles are checked with or without the doc contract
+    cross.extend(check_lock_order(facts_by_path, lock_doc_rel,
+                                  lock_doc_text))
     if obs_doc:
         doc_rel = _doc_relpath(obs_doc)
         try:

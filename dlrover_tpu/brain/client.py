@@ -49,7 +49,10 @@ class BrainClient:
                 config_json=json.dumps(config or {}),
             )), timeout=self._timeout_s))
         if isinstance(response, msg.BrainResourcePlan) and response.found:
-            return json.loads(response.plan_json)
+            # advisory resource plan, not a world-stamped execution
+            # plan: the brain has no epoch/generation to validate, and
+            # the auto-scaler re-checks cluster state before acting
+            return json.loads(response.plan_json)  # graftlint: disable=GL704
         return {}
 
     def get_job_metrics(self, job_name: str,

@@ -31,7 +31,9 @@ class CoworkerDataService:
                  host: str = "0.0.0.0"):
         self._queues: dict = {}
         self._capacity = capacity
-        self._finished = False
+        # single False->True transition read from RPC threads and
+        # the trainer's consumer loop: an Event, not a bare bool
+        self._finished = threading.Event()
         self._lock = threading.Lock()
         self._server, self.port = build_server(
             self._get_bytes, self._report_bytes, port=port, host=host)
@@ -61,7 +63,7 @@ class CoworkerDataService:
             return msg.serialize_message(msg.CoworkerInfo(  # graftlint: disable=GL401
                 dataset_name=request.dataset_name,
                 queued=q.qsize(), capacity=self._capacity,
-                finished=self._finished,
+                finished=self._finished.is_set(),
             ))
         return msg.serialize_message(
             msg.Response(success=False, reason="unknown request"))
@@ -82,7 +84,7 @@ class CoworkerDataService:
 
     # -- trainer-side consumption ----------------------------------------
     def mark_finished(self) -> None:
-        self._finished = True
+        self._finished.set()
 
     def batches(self, dataset_name: str = "default",
                 timeout_s: Optional[float] = 60.0) -> Iterator[Any]:
@@ -96,7 +98,7 @@ class CoworkerDataService:
                 last_progress = time.time()
                 yield pickle.loads(payload)
             except queue.Empty:
-                if self._finished:
+                if self._finished.is_set():
                     return
                 if (timeout_s is not None
                         and time.time() - last_progress > timeout_s):

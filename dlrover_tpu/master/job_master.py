@@ -231,6 +231,14 @@ class JobMaster:
         if state_dir:
             self._state_backend = MasterStateBackend(state_dir,
                                                      retain=retain)
+            # snapshots stop the moment a higher-generation master owns
+            # the lineage.  The gate reads the latched flag, NOT
+            # _check_fenced: backend saves run under _snapshot_lock,
+            # which _check_fenced itself acquires (the deep bootstrap
+            # probe already ran at _maybe_snapshot entry, pre-lock).
+            # Lock-free read is safe: _fenced only ever goes False→True
+            self._state_backend.gate = (
+                lambda: self._fenced)  # graftlint: disable=GL201
             self.generation = 1
             loaded = (preloaded_state if preloaded_state is not None
                       else self._state_backend.load_latest())
@@ -636,7 +644,9 @@ class JobMaster:
         if port < 0:
             return
         try:
-            self._metrics_server, self.metrics_port = (
+            # bound during prepare(), before run_in_thread() spawns:
+            # the run thread only reads it at shutdown
+            self._metrics_server, self.metrics_port = (  # graftlint: disable=GL701
                 obs.start_http_exporter(port=port))
         except OSError as e:
             logger.warning("metrics exporter failed to bind: %s", e)
@@ -655,7 +665,8 @@ class JobMaster:
                     break
                 if stage == JobStage.FAILED:
                     exit_code = 1
-                    self._exit_reason = self.job_manager.exit_reason()
+                    # single writer (this loop); read after run() exits
+                    self._exit_reason = self.job_manager.exit_reason()  # graftlint: disable=GL701
                     break
             elif self.task_manager.finished():
                 logger.info("all datasets exhausted: job succeeded")
@@ -664,7 +675,8 @@ class JobMaster:
                 logger.error("job hanged > %.0fs without step progress",
                              ctx.hang_seconds)
                 exit_code = 1
-                self._exit_reason = "hang"
+                # single writer (this loop); read after run() exits
+                self._exit_reason = "hang"  # graftlint: disable=GL701
                 break
             self._stopped.wait(poll_interval_s)
         self.stop()

@@ -41,6 +41,9 @@ class JobAutoScaler:
         self._interval_s = interval_s
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # the tuned-knob compare-and-publish runs on the scaler thread
+        # and from direct execute_job_optimization() callers
+        self._tuned_lock = threading.Lock()
         self.paral_config_version = 0
         self.suggested_dataloader_workers = 0
         # callable(**fields) merging tuned knobs into the published config
@@ -92,11 +95,14 @@ class JobAutoScaler:
             {"worker_count": current, "max_worker_count": max_count},
         )
         plan.limit(self._limits)
-        if (plan.dataloader_workers
-                and plan.dataloader_workers
-                != self.suggested_dataloader_workers):
-            self.suggested_dataloader_workers = plan.dataloader_workers
-            self.paral_config_version += 1
+        with self._tuned_lock:
+            tuned = (plan.dataloader_workers
+                     and plan.dataloader_workers
+                     != self.suggested_dataloader_workers)
+            if tuned:
+                self.suggested_dataloader_workers = plan.dataloader_workers
+                self.paral_config_version += 1
+        if tuned:
             decision.set_attr("outcome", "tuned")
             if self.paral_config_sink is not None:
                 self.paral_config_sink(

@@ -9,6 +9,7 @@ routes.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, Optional
 
@@ -92,6 +93,11 @@ class MasterServicer:
         # measurements, learned discounts push back into the planner
         self.plan_calibration = plan_calibration
         self._pushed_discounts: Dict[str, float] = {}
+        # the tuned config is read on RPC threads and merged from the
+        # auto-scaler thread: every access goes through _paral_lock or
+        # merge's read-modify-write can lose a concurrently reported
+        # config (and publish a stale version number)
+        self._paral_lock = threading.Lock()
         self._paral_config = msg.ParallelConfig()
         self._start_time = time.time()
         # crash-consistency hook (wired by JobMaster): called after any
@@ -316,7 +322,8 @@ class MasterServicer:
             return msg.TaskCounts(dataset_name=request.dataset_name,
                                   todo=todo, doing=doing)
         if isinstance(request, msg.ParallelConfigRequest):
-            return self._paral_config
+            with self._paral_lock:
+                return self._paral_config
         if isinstance(request, msg.SyncQueryRequest):
             finished = self.sync_service.sync_finished(request.sync_name)
             return msg.Response(success=finished)
@@ -530,7 +537,8 @@ class MasterServicer:
                 request.task_type, request.task_id,
             )
         elif isinstance(request, msg.ParallelConfig):
-            self._paral_config = request
+            with self._paral_lock:
+                self._paral_config = request
         elif isinstance(request, msg.ScaleRequest):
             if self.job_manager is not None:
                 self.job_manager.handle_scale_request(request)
@@ -925,16 +933,21 @@ class MasterServicer:
         return msg.JobStatus(stage=stage)
 
     def update_paral_config(self, config: msg.ParallelConfig) -> None:
-        self._paral_config = config
+        with self._paral_lock:
+            self._paral_config = config
 
     def merge_paral_config(self, **fields) -> msg.ParallelConfig:
         """Merge tuned knobs into the current config, bumping its version
         (partial updates must not clobber other tuned fields or publish a
-        stale version number)."""
+        stale version number).  The read-modify-write holds _paral_lock:
+        the auto-scaler merges on its own thread while RPC threads
+        report/replace the config."""
         import dataclasses
 
-        current = self._paral_config
-        self._paral_config = dataclasses.replace(
-            current, version=current.version + 1,
-            **{k: v for k, v in fields.items() if v})
-        return self._paral_config
+        with self._paral_lock:
+            current = self._paral_config
+            merged = dataclasses.replace(
+                current, version=current.version + 1,
+                **{k: v for k, v in fields.items() if v})
+            self._paral_config = merged
+        return merged

@@ -80,8 +80,16 @@ class StandbyMaster:
             promote_failures if promote_failures is not None
             else ctx.standby_promote_failures))
         self._backend = MasterStateBackend(state_dir)
+        # a standby must never write the snapshot lineage it tails —
+        # the backend stays permanently fenced (promotion hands the
+        # state dir to a fresh JobMaster with its own gated backend)
+        self._backend.gate = lambda: True
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # guards the watch-state shared between the standby thread
+        # (run/refresh/probe) and the caller thread (start/stop/tests);
+        # never held across a probe RPC or a snapshot disk read
+        self._lock = threading.Lock()
         # warm state: (state dict, snapshot version) — what promotion
         # hands to JobMaster so it skips the cold disk read
         self.warm_state: Optional[Tuple[dict, int]] = None
@@ -95,17 +103,20 @@ class StandbyMaster:
     def refresh_warm_state(self) -> bool:
         """Load the newest snapshot if the stream advanced past what we
         hold; returns whether anything new was adopted."""
+        with self._lock:
+            held_version = self.warm_version
         versions = self._backend.versions()
-        if not versions or versions[-1] <= self.warm_version:
+        if not versions or versions[-1] <= held_version:
             return False
         loaded = self._backend.load_latest()
         if loaded is None:
             return False
         state, version = loaded
-        if version <= self.warm_version:
-            return False
-        self.warm_state = (state, version)
-        self.warm_version = version
+        with self._lock:
+            if version <= self.warm_version:
+                return False
+            self.warm_state = (state, version)
+            self.warm_version = version
         obs.get_registry().gauge(
             "dlrover_tpu_standby_warm_snapshot_version",
             "Newest snapshot version the hot standby holds parsed in "
@@ -127,18 +138,24 @@ class StandbyMaster:
         addr = self._primary_addr()
         if not addr:
             return True
-        if addr != self._probe_addr or self._probe_client is None:
-            if self._probe_client is not None:
-                try:
-                    self._probe_client.close()
-                except Exception:  # noqa: BLE001 — dead channel
-                    pass
-            self._probe_client = MasterClient(
-                addr, node_id=-1, node_type="standby",
-                timeout_s=max(1.0, self._health_interval_s))
-            self._probe_addr = addr
+        with self._lock:
+            probe = self._probe_client
+            stale = None
+            if addr != self._probe_addr or probe is None:
+                # channel construction is lazy (no connect): safe to
+                # swap under the lock; the dead channel closes outside
+                stale, probe = probe, MasterClient(
+                    addr, node_id=-1, node_type="standby",
+                    timeout_s=max(1.0, self._health_interval_s))
+                self._probe_client = probe
+                self._probe_addr = addr
+        if stale is not None:
+            try:
+                stale.close()
+            except Exception:  # noqa: BLE001 — dead channel
+                pass
         try:
-            self._probe_client.get_job_status()
+            probe.get_job_status()
             return True
         except Exception:  # noqa: BLE001 — any failure is a failed probe
             return False
@@ -158,13 +175,16 @@ class StandbyMaster:
         while not self._stopped.is_set():
             self.refresh_warm_state()
             if self.check_primary():
-                self.consecutive_failures = 0
+                with self._lock:
+                    self.consecutive_failures = 0
             else:
-                self.consecutive_failures += 1
+                with self._lock:
+                    self.consecutive_failures += 1
+                    failures = self.consecutive_failures
                 logger.warning(
                     "primary health probe failed (%d/%d consecutive)",
-                    self.consecutive_failures, self._promote_failures)
-                if self.consecutive_failures >= self._promote_failures:
+                    failures, self._promote_failures)
+                if failures >= self._promote_failures:
                     master = self.promote()
                     if master is not None:
                         return master.run()
@@ -179,11 +199,14 @@ class StandbyMaster:
 
     def stop(self) -> None:
         self._stopped.set()
-        if self.promoted_master is not None:
-            self.promoted_master.stop(grace_s=0.1)
-        if self._probe_client is not None:
+        with self._lock:
+            promoted = self.promoted_master
+            probe = self._probe_client
+        if promoted is not None:
+            promoted.stop(grace_s=0.1)
+        if probe is not None:
             try:
-                self._probe_client.close()
+                probe.close()
             except Exception:  # noqa: BLE001
                 pass
 
@@ -201,31 +224,36 @@ class StandbyMaster:
         # one last look at the stream: the primary may have snapshotted
         # between our last tail and its death
         self.refresh_warm_state()
+        with self._lock:
+            warm_state = self.warm_state
+            warm_version = self.warm_version
+            failures = self.consecutive_failures
         logger.critical(
             "PROMOTING: primary failed %d consecutive health probes; "
             "standby takes over from snapshot v%d",
-            self.consecutive_failures, self.warm_version)
+            failures, warm_version)
         master = JobMaster(
             port=self._port, min_nodes=self._min_nodes,
             max_nodes=self._max_nodes, node_unit=self._node_unit,
             host=self._host, state_dir=self._state_dir,
-            preloaded_state=self.warm_state)
+            preloaded_state=warm_state)
         master.prepare()   # serves + publishes the bootstrap handoff
         took_s = time.monotonic() - started
-        self.promoted_master = master
+        with self._lock:
+            self.promoted_master = master
         obs.get_flight_recorder().record_event(
             "master_promoted", addr=master.addr,
             coord_addr=master.coord_addr,
             generation=master.generation,
-            snapshot_version=self.warm_version,
-            failed_probes=self.consecutive_failures,
+            snapshot_version=warm_version,
+            failed_probes=failures,
             promotion_s=round(took_s, 4))
         obs.get_registry().counter(
             "dlrover_tpu_master_promotions_total",
             "Hot-standby masters promoted to primary").inc()
         obs.record_span("master_promotion", took_s,
                         attrs={"generation": master.generation,
-                               "snapshot_version": self.warm_version})
+                               "snapshot_version": warm_version})
         logger.critical(
             "PROMOTED in %.3fs: serving at %s (coord %s) as generation "
             "%d", took_s, master.addr, master.coord_addr or "-",

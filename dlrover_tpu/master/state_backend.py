@@ -29,7 +29,7 @@ import json
 import os
 import re
 import threading
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.log import default_logger as logger
@@ -348,6 +348,12 @@ class MasterStateBackend:
         self._dir = directory
         self._retain = retain
         self._lock = threading.Lock()
+        # double-primary fencing: wired by the owner (JobMaster sets
+        # _check_fenced; a standby pins it permanently closed).  gate()
+        # returning True means "deposed": every save becomes a no-op so
+        # a stale master cannot interleave snapshot versions with the
+        # promoted one's writes over the shared lineage.
+        self.gate: Optional[Callable[[], bool]] = None
         os.makedirs(directory, exist_ok=True)
         existing = self.versions()
         self._next_version = (existing[-1] + 1) if existing else 1
@@ -374,8 +380,11 @@ class MasterStateBackend:
         return sorted(found)
 
     # -- writing -----------------------------------------------------------
-    def save(self, state: Dict[str, Any]) -> str:
-        """Write a new snapshot version atomically; returns its path."""
+    def save(self, state: Dict[str, Any]) -> Optional[str]:
+        """Write a new snapshot version atomically; returns its path
+        (None when the fence gate reports this writer deposed)."""
+        if self.gate is not None and self.gate():
+            return None
         payload = _canonical(state)
         return self._write(state, payload)
 
@@ -383,6 +392,8 @@ class MasterStateBackend:
         """Write only when the state differs from the last written
         snapshot (the per-mutation hook: polls that mutate nothing must
         not churn versions). Returns the path, or None when skipped."""
+        if self.gate is not None and self.gate():
+            return None
         payload = _canonical(state)
         with self._lock:
             if self._last_checksum and \

@@ -421,7 +421,13 @@ class TimeSeriesSidecar:
     def _checksum(payload: str) -> str:
         return hashlib.sha256(payload.encode("utf-8")).hexdigest()
 
-    def save(self, store: TimeSeriesStore) -> bool:
+    def save(self, store: TimeSeriesStore,
+             gate: Optional[Callable[[], bool]] = None) -> bool:
+        # fence check at the writer itself, not only in the collector's
+        # flush cadence: a deposed master's direct save must not clobber
+        # the promoted master's history file either
+        if gate is not None and gate():
+            return False
         state = store.export_state()
         payload = json.dumps(state, sort_keys=True,
                              separators=(",", ":"))
@@ -593,10 +599,12 @@ class TsdbCollector:
         cadence but never touches the file again."""
         if self._sidecar is None:
             return False
-        self._last_flush = self._clock()
+        # cadence marker only: stop() joins the loop before its final
+        # flush, and a raced float write merely shifts one interval
+        self._last_flush = self._clock()  # graftlint: disable=GL701
         if self.gate is not None and self.gate():
             return False
-        return self._sidecar.save(self._store)
+        return self._sidecar.save(self._store, gate=self.gate)
 
     def start(self) -> None:
         if self._sample_interval_s <= 0 or self._thread is not None:

@@ -68,6 +68,10 @@ class ElasticJobController:
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._master_handle = None
+        # guards the reconcile state shared between the controller
+        # thread and CR-entry callers (submit_scale_plan/observe/tests);
+        # never held across a cluster call, master launch, or relay RPC
+        self._lock = threading.Lock()
 
     # -- observation ---------------------------------------------------
     def observe(self) -> JobObserved:
@@ -76,13 +80,18 @@ class ElasticJobController:
             master_phase = _POD_STATUS_TO_PHASE.get(pod.status,
                                                     PodPhase.ABSENT)
         workers = self._cluster.list_pods(NodeType.WORKER)
+        with self._lock:
+            job_phase = self.phase
+            master_restarts = self.master_restarts
+            suspended = self.suspended
+            pending = bool(self.pending_scale_plans)
         return JobObserved(
-            job_phase=self.phase,
+            job_phase=job_phase,
             master_phase=master_phase,
-            master_restarts=self.master_restarts,
+            master_restarts=master_restarts,
             max_master_restarts=self.max_master_restarts,
-            suspended=self.suspended,
-            pending_scale_plan=bool(self.pending_scale_plans),
+            suspended=suspended,
+            pending_scale_plan=pending,
             workers_total=len(workers),
             workers_running=sum(
                 1 for p in workers if p.status == NodeStatus.RUNNING),
@@ -97,17 +106,21 @@ class ElasticJobController:
         if action.kind == ActionKind.CREATE_MASTER:
             self._create_master()
         elif action.kind == ActionKind.RELAUNCH_MASTER:
-            self.master_restarts = action.arg
+            with self._lock:
+                self.master_restarts = action.arg
             logger.warning("relaunching master (%d/%d)",
-                           self.master_restarts, self.max_master_restarts)
+                           action.arg, self.max_master_restarts)
             for pod in self._cluster.list_pods(NodeType.MASTER):
                 self._cluster.delete_pod(pod.name)
             self._create_master()
         elif action.kind == ActionKind.SET_PHASE:
-            if self.phase != action.arg:
+            with self._lock:
+                changed = self.phase != action.arg
+                if changed:
+                    self.phase = action.arg
+            if changed:
                 logger.info("job %s phase -> %s", self._job_name,
                             PHASE_NAMES[action.arg])
-                self.phase = action.arg
         elif action.kind == ActionKind.RELAY_SCALE_PLAN:
             self._relay_scale_plan()
         elif action.kind == ActionKind.FAIL_JOB:
@@ -115,34 +128,47 @@ class ElasticJobController:
                          action.arg)
 
     def _create_master(self) -> None:
+        with self._lock:
+            ordinal = self.master_restarts
         if hasattr(self._cluster, "create_master"):
             # k8s backend: master runs as a pod behind a stable service
             # (reference: master/master.go:53-162). The pod name carries
             # the restart ordinal: a relaunch must not collide with the
             # old pod's asynchronous (graceful) deletion.
-            self.master_addr = self._cluster.create_master(
-                ordinal=self.master_restarts)
+            addr = self._cluster.create_master(ordinal=ordinal)
+            with self._lock:
+                self.master_addr = addr
             return
         from dlrover_tpu.scheduler.local import PodRecord
 
         if self._master_factory is not None:
-            self._master_handle, self.master_addr = self._master_factory()
+            # the factory launches a full master: keep the lock out of
+            # that call and publish handle + addr once it returns
+            handle, addr = self._master_factory()
+            with self._lock:
+                self._master_handle = handle
+                self.master_addr = addr
+        else:
+            with self._lock:
+                addr = self.master_addr
         self._cluster.create_pod(PodRecord(
             name=f"{self._job_name}-master-0",
             node_type=NodeType.MASTER,
             node_id=0,
             rank_index=0,
-            env={"DLROVER_TPU_MASTER_ADDR": self.master_addr},
+            env={"DLROVER_TPU_MASTER_ADDR": addr},
         ))
 
     def _relay_scale_plan(self) -> None:
-        plans, self.pending_scale_plans = self.pending_scale_plans, {}
-        if not plans or not self.master_addr:
+        with self._lock:
+            plans, self.pending_scale_plans = self.pending_scale_plans, {}
+            addr = self.master_addr
+        if not plans or not addr:
             return
         from dlrover_tpu.agent.master_client import MasterClient
 
         try:
-            client = MasterClient(self.master_addr, node_id=-1)
+            client = MasterClient(addr, node_id=-1)
             try:
                 for node_type, count in list(plans.items()):
                     client._report(msg.ScaleRequest(node_type=node_type,
@@ -155,12 +181,14 @@ class ElasticJobController:
         except Exception as e:  # noqa: BLE001
             logger.warning("scale-plan relay failed: %s; requeued", e)
             # not-yet-sent entries go back; a newer request wins
-            for node_type, count in plans.items():
-                self.pending_scale_plans.setdefault(node_type, count)
+            with self._lock:
+                for node_type, count in plans.items():
+                    self.pending_scale_plans.setdefault(node_type, count)
 
     def submit_scale_plan(self, node_type: str, count: int) -> None:
         """The ScalePlan-CR entry (reference: ScalePlanReconciler)."""
-        self.pending_scale_plans[node_type] = count
+        with self._lock:
+            self.pending_scale_plans[node_type] = count
 
     # -- loop ------------------------------------------------------------
     def reconcile_once(self) -> JobObserved:

@@ -29,6 +29,44 @@ assert len(jax.devices("cpu")) >= 8, (
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _graftrace_lockcheck():
+    """graftrace runtime lock sanitizer, gated on
+    ``DLROVER_TPU_LOCKCHECK=1``: traces every package lock created
+    during the session, dumps the flight-style report at teardown
+    (``DLROVER_TPU_LOCKCHECK_OUT``, default
+    /tmp/graftrace_lockcheck.json), and FAILS the session on an
+    observed lock-order cycle or a blocking call made under a
+    gradient-path lock.  ``tools/graftrace.py --diff`` then compares
+    the dump against the static GL702 model."""
+    import json
+
+    from dlrover_tpu.analysis import lockcheck
+
+    if os.environ.get(lockcheck.ENV_FLAG) != "1":
+        yield
+        return
+    lockcheck.install()
+    try:
+        yield
+    finally:
+        report = lockcheck.report()
+        lockcheck.uninstall()
+        out = os.environ.get(lockcheck.ENV_OUT, lockcheck.DEFAULT_OUT)
+        with open(out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+        problems = []
+        for cycle in report["cycles"]:
+            problems.append("observed lock-order cycle: "
+                            + " -> ".join(cycle + cycle[:1]))
+        for ev in report["hot_blocking"]:
+            problems.append(
+                f"blocking {ev['func']} under gradient-path lock(s) "
+                f"{', '.join(ev['hot_held'])} at {ev['site']}")
+        assert not problems, \
+            "graftrace lockcheck: " + "; ".join(problems)
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     devices = jax.devices("cpu")

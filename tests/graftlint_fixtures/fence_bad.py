@@ -1,0 +1,35 @@
+# graftlint fixture: deliberate fence-discipline violations. Never
+# imported/executed; `# BAD: <rule>` markers are asserted exactly.
+import os
+
+
+class SnapshotWriter:
+    """State-dir writer that never consults the fence gate."""
+
+    def __init__(self, state_dir):
+        self._dir = state_dir
+
+    def save(self, payload):
+        tmp = self._dir + "/snap.tmp"
+        with open(tmp, "w") as fh:                # BAD: GL703
+            fh.write(payload)
+        os.replace(tmp, self._dir + "/snap")
+
+
+class GatedLog:
+    """Properly gated writer — but see Master below."""
+
+    def __init__(self, state_dir):
+        self.gate = None
+        self._dir = state_dir
+
+    def append(self, row):
+        if self.gate is not None and self.gate():
+            return
+        with open(self._dir + "/log", "a") as fh:
+            fh.write(row)
+
+
+class Master:
+    def __init__(self, state_dir):
+        self._log = GatedLog(state_dir)           # BAD: GL703

@@ -1,0 +1,39 @@
+# graftlint fixture: the safe mirrors of fence_bad — every state-dir
+# writer consults the gate, every construction site wires it.
+import os
+
+
+class SnapshotWriter:
+    def __init__(self, state_dir, gate=None):
+        self._dir = state_dir
+        self.gate = gate
+
+    def save(self, payload):
+        if self.gate is not None and self.gate():
+            return
+        tmp = self._dir + "/snap.tmp"
+        with open(tmp, "w") as fh:
+            fh.write(payload)
+        os.replace(tmp, self._dir + "/snap")
+
+
+class GatedLog:
+    def __init__(self, state_dir):
+        self.gate = None
+        self._dir = state_dir
+
+    def append(self, row):
+        if self.gate is not None and self.gate():
+            return
+        with open(self._dir + "/log", "a") as fh:
+            fh.write(row)
+
+
+class Master:
+    def __init__(self, state_dir):
+        self._log = GatedLog(state_dir)
+        self._log.gate = self._fenced
+        self._snap = SnapshotWriter(state_dir, gate=self._fenced)
+
+    def _fenced(self):
+        return False

@@ -1,0 +1,16 @@
+# graftlint fixture: one half of a cross-file lock-order inversion.
+# Alpha holds its lock while calling into Beta (alpha -> beta)...
+import threading
+
+from pkg.beta import Beta
+
+
+class Alpha:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._beta = Beta()
+        self.items = []
+
+    def push(self, item):
+        with self._lock:
+            self._beta.forward(item)              # BAD: GL702

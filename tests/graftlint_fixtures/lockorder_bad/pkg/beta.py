@@ -1,0 +1,20 @@
+# graftlint fixture: ...and Beta holds its lock while calling back
+# into Alpha (beta -> alpha), closing the cycle. The Alpha side is
+# reached through a module factory to exercise factory resolution.
+import threading
+
+
+class Beta:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._owner = make_owner()
+
+    def forward(self, item):
+        with self._lock:
+            self._owner.push(item)                # BAD: GL702
+
+
+def make_owner():
+    from pkg.alpha import Alpha
+
+    return Alpha()

@@ -1,0 +1,16 @@
+# graftlint fixture: the safe mirror of lockorder_bad — one canonical
+# direction (alpha -> beta), documented in lockdoc.md.
+import threading
+
+from pkg.beta import Beta
+
+
+class Alpha:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._beta = Beta()
+        self.items = []
+
+    def push(self, item):
+        with self._lock:
+            self._beta.forward(item)

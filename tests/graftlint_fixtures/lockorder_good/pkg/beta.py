@@ -1,0 +1,13 @@
+# graftlint fixture: Beta takes its own lock but never calls back out
+# while holding it — the graph stays a hierarchy.
+import threading
+
+
+class Beta:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = []
+
+    def forward(self, item):
+        with self._lock:
+            self.rows.append(item)

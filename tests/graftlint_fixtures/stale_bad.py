@@ -1,0 +1,16 @@
+# graftlint fixture: deliberate staleness-discipline violations. Never
+# imported/executed; `# BAD: <rule>` markers are asserted exactly.
+import json
+
+
+def read_sync_payload(store):
+    return store.get("dcn/slice0/grads")          # BAD: GL704
+
+
+def publish_heartbeat(store, payload):
+    store.put("coord/heartbeat/0", payload)       # BAD: GL704
+
+
+def apply_plan(plan_json):
+    plan = json.loads(plan_json)                  # BAD: GL704
+    return plan

@@ -1,0 +1,23 @@
+# graftlint fixture: the safe mirrors of stale_bad — hot-KV keys carry
+# their generation segment, parsed plans validate their stamp.
+import json
+
+
+def read_sync_payload(store, epoch):
+    return store.get(f"dcn/{epoch}/slice0/grads")
+
+
+def publish_heartbeat(store, payload, generation):
+    store.put(f"coord/{generation}/heartbeat/0", payload)
+
+
+def apply_plan(plan_json, expected_epoch):
+    plan = json.loads(plan_json)
+    if plan.get("epoch") != expected_epoch:
+        return None
+    return plan
+
+
+def is_hot(key):
+    # a bare-prefix literal is a prefix CHECK, not a key
+    return key.startswith("dcn/")

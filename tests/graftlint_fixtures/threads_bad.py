@@ -1,0 +1,39 @@
+# graftlint fixture: deliberate cross-thread unguarded access. Never
+# imported/executed; `# BAD: <rule>` markers are asserted exactly.
+import threading
+
+
+class PoolMonitor:
+    """Background thread publishes, main thread reads — no lock."""
+
+    def __init__(self):
+        self._latest = None
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._latest = self._poll()           # BAD: GL701
+
+    def latest(self):
+        return self._latest
+
+    def _poll(self):
+        return 1
+
+
+class StatusService:
+    """RPC pool threads enter every public method concurrently."""
+
+    def __init__(self):
+        self._counter = 0
+
+    def report(self, request):
+        self._counter += 1                        # BAD: GL701
+        return self._counter
+
+    def get(self, request):
+        return self._counter

@@ -1,0 +1,44 @@
+# graftlint fixture: the safe mirrors of threads_bad — same thread
+# shapes, every cross-context access shares one lock (or happens
+# strictly before the spawn).
+import threading
+
+
+class PoolMonitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latest = None
+        self._thread = None
+
+    def start(self):
+        # written before the thread starts: happens-before the loop
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            value = self._poll()
+            with self._lock:
+                self._latest = value
+
+    def latest(self):
+        with self._lock:
+            return self._latest
+
+    def _poll(self):
+        return 1
+
+
+class StatusService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counter = 0
+
+    def report(self, request):
+        with self._lock:
+            self._counter += 1
+            return self._counter
+
+    def get(self, request):
+        with self._lock:
+            return self._counter

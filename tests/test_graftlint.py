@@ -45,11 +45,13 @@ def _found(path: Path, relpath=None):
 # -- rule catalog ----------------------------------------------------------
 
 def test_rule_catalog():
-    assert len(RULES) >= 17
+    assert len(RULES) >= 21
     passes = {r.pass_name for r in RULES.values()}
     assert passes == {"trace-safety", "lock-discipline",
                       "state-roundtrip", "protocol-symmetry",
-                      "hot-path-blocking", "obs-drift"}
+                      "hot-path-blocking", "obs-drift",
+                      "thread-roster", "lock-order",
+                      "fence-discipline", "staleness-discipline"}
     for rule in RULES.values():
         assert rule.hint and rule.title
         assert rule.version >= 1
@@ -114,6 +116,68 @@ def test_hot_path_blocking_fixtures():
     bad = FIXTURES / "hotlock_bad.py"
     assert _found(bad) == _expected(bad)
     assert _found(FIXTURES / "hotlock_good.py") == set()
+
+
+def test_thread_roster_fixtures():
+    bad = FIXTURES / "threads_bad.py"
+    assert _found(bad) == _expected(bad)
+    assert _found(FIXTURES / "threads_good.py") == set()
+
+
+def test_staleness_fixtures():
+    bad = FIXTURES / "stale_bad.py"
+    assert _found(bad) == _expected(bad)
+    assert _found(FIXTURES / "stale_good.py") == set()
+
+
+def test_fence_fixtures():
+    # GL703 pools facts cross-module: drive it through run_analysis
+    bad = FIXTURES / "fence_bad.py"
+    result = run_analysis([str(bad)])
+    assert {(f.line, f.rule_id) for f in result.findings} == \
+        _expected(bad)
+    good = run_analysis([str(FIXTURES / "fence_good.py")])
+    assert good.findings == []
+
+
+def test_lock_order_fixture_packages():
+    """Cross-file inversion (through a ctor binding one way and a
+    module factory the other) plus both directions of doc drift."""
+    root = FIXTURES / "lockorder_bad"
+    result = run_analysis([str(root / "pkg")],
+                          lock_doc=str(root / "lockdoc.md"))
+    expected = _package_expected(root / "pkg")
+    for line, rule in _expected(root / "lockdoc.md"):
+        expected.add(("lockorder_bad/lockdoc.md", line, rule))
+    assert _package_found(result) == expected
+    cycle = [f for f in result.findings if "cycle" in f.message]
+    assert len(cycle) == 1
+    assert "Alpha._lock -> Beta._lock -> Alpha._lock" in \
+        cycle[0].message
+
+    good = FIXTURES / "lockorder_good"
+    silent = run_analysis([str(good / "pkg")],
+                          lock_doc=str(good / "lockdoc.md"))
+    assert silent.findings == []
+
+
+def test_lock_order_missing_doc_is_an_error(tmp_path):
+    """Deleting/renaming the hierarchy table must FAIL the run, not
+    silently skip the doc half of GL702."""
+    good = FIXTURES / "lockorder_good"
+    result = run_analysis([str(good / "pkg")],
+                          lock_doc=str(tmp_path / "gone.md"))
+    assert any("lock-order table unreadable" in err
+               for err in result.parse_errors)
+
+
+def test_lock_order_cycles_checked_without_doc():
+    """Cycle detection must not depend on the doc contract being
+    wired (a --no-lock-order run still fails on a deadlock shape)."""
+    root = FIXTURES / "lockorder_bad"
+    result = run_analysis([str(root / "pkg")])
+    assert any("cycle" in f.message for f in result.findings
+               if f.rule_id == "GL702")
 
 
 # -- cross-module passes: protocol symmetry + obs drift ---------------------
@@ -423,7 +487,9 @@ def test_package_has_no_new_findings(tmp_path):
     # emits, both directions (acceptance criterion)
     result = run_analysis([str(REPO / "dlrover_tpu")],
                           baseline=baseline, cache_path=str(cache),
-                          obs_doc=str(REPO / "docs" / "observability.md"))
+                          obs_doc=str(REPO / "docs" / "observability.md"),
+                          lock_doc=str(REPO / "docs" /
+                                       "fault_tolerance.md"))
     assert result.parse_errors == []
     assert result.files_analyzed > 100
     msg = "\n".join(f.format() for f in result.new_findings)
@@ -436,7 +502,9 @@ def test_package_has_no_new_findings(tmp_path):
     started = time.monotonic()
     warm = run_analysis([str(REPO / "dlrover_tpu")],
                         baseline=baseline, cache_path=str(cache),
-                        obs_doc=str(REPO / "docs" / "observability.md"))
+                        obs_doc=str(REPO / "docs" / "observability.md"),
+                        lock_doc=str(REPO / "docs" /
+                                     "fault_tolerance.md"))
     warm_wall = time.monotonic() - started
     assert warm.cache_misses == 0
     assert warm.cache_hits == result.files_analyzed
@@ -466,8 +534,10 @@ def test_cli_gate_and_listing():
         capture_output=True, text=True, cwd=REPO)
     assert bad.returncode == 1
     payload = json.loads(bad.stdout)
+    # the both-orders nesting that trips GL202 per-file also closes a
+    # cycle in the pooled GL702 graph — both fire, by design
     assert {f["rule_id"] for f in payload["new_findings"]} == {
-        "GL201", "GL202", "GL203", "GL204", "GL205"}
+        "GL201", "GL202", "GL203", "GL204", "GL205", "GL702"}
     assert payload["cache"] == {"hits": 0, "misses": 1}
 
 

@@ -1,0 +1,372 @@
+"""graftrace: the runtime lock sanitizer, its diff against the static
+model, and regression tests for the concrete findings the GL701–GL704
+passes surfaced in the fleet (each test pins the fixed behaviour).
+
+The lockcheck unit tests run in SUBPROCESSES on purpose: ``install()``
+is process-global (it patches the ``threading`` lock factories), the
+session conftest fixture may already own it, and a deliberately
+inverted acquisition order must not leak a cycle into the session
+fixture's teardown assertion.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _run_py(script: str, cwd: Path, timeout: int = 120,
+            env_extra: dict = None) -> subprocess.CompletedProcess:
+    env = {k: v for k, v in os.environ.items()
+           if k != "DLROVER_TPU_LOCKCHECK"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, cwd=cwd,
+                          env=env, timeout=timeout)
+
+
+# -- GL704 mirror drift ------------------------------------------------------
+
+def test_hot_kv_prefixes_mirror_constants():
+    """The staleness pass mirrors HOT_KV_PREFIXES (it must not import
+    the package it lints); the mirror must track the real constant."""
+    from dlrover_tpu.analysis import contracts
+    from dlrover_tpu.common import constants
+
+    assert contracts.HOT_KV_PREFIXES == constants.HOT_KV_PREFIXES
+
+
+# -- runtime sanitizer -------------------------------------------------------
+
+_INVERSION_SCRIPT = """\
+import json, sys, threading
+sys.path.insert(0, {repo!r})
+from dlrover_tpu.analysis import lockcheck
+
+lockcheck.install(extra_paths=(r"{here}",))
+
+
+class Pair:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+
+p = Pair()
+
+
+def fwd():
+    with p.a:
+        with p.b:
+            pass
+
+
+def rev():
+    with p.b:
+        with p.a:
+            pass
+
+
+# serialized, so the inversion is observed without actually deadlocking
+t1 = threading.Thread(target=fwd); t1.start(); t1.join()
+t2 = threading.Thread(target=rev); t2.start(); t2.join()
+
+rep = lockcheck.report()
+lockcheck.uninstall()
+print(json.dumps(rep))
+"""
+
+
+def test_lockcheck_reports_inverted_acquisition_order(tmp_path):
+    script = tmp_path / "inversion.py"
+    script.write_text(_INVERSION_SCRIPT.format(
+        repo=str(REPO), here=str(tmp_path)))
+    proc = _run_py(script, cwd=tmp_path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["cycles"], "inverted two-lock order must report a cycle"
+    ring = {n for cycle in rep["cycles"] for n in cycle}
+    assert ring == {"Pair.a", "Pair.b"}
+    observed = {(e["outer"], e["inner"]) for e in rep["edges"]}
+    assert ("Pair.a", "Pair.b") in observed
+    assert ("Pair.b", "Pair.a") in observed
+
+
+_CLEAN_SCRIPT = """\
+import json, sys, threading
+sys.path.insert(0, {repo!r})
+from dlrover_tpu.analysis import lockcheck
+
+lockcheck.install(extra_paths=(r"{here}",))
+
+
+class Pair:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+
+p = Pair()
+for _ in range(3):
+    with p.a:
+        with p.b:
+            pass
+
+rep = lockcheck.report()
+lockcheck.uninstall()
+print(json.dumps(rep))
+"""
+
+
+def test_lockcheck_consistent_order_is_clean(tmp_path):
+    script = tmp_path / "clean.py"
+    script.write_text(_CLEAN_SCRIPT.format(
+        repo=str(REPO), here=str(tmp_path)))
+    proc = _run_py(script, cwd=tmp_path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["cycles"] == []
+    assert [(e["outer"], e["inner"]) for e in rep["edges"]] \
+        == [("Pair.a", "Pair.b")]
+
+
+# -- static model: multi-hop closure -----------------------------------------
+
+def test_runtime_pairs_closes_over_class_calls(tmp_path):
+    """An outer lock held across a call chain A -> B -> C shows up at
+    runtime as A.lock -> C.lock even though no single file nests them;
+    runtime_pairs must model it, while the tight one-hop expansion
+    (what cycle/doc findings run on) must NOT grow the synthetic pair."""
+    import ast
+
+    from dlrover_tpu.analysis.concurrency import (
+        analyze_concurrency,
+        build_lock_model,
+        runtime_pairs,
+    )
+
+    src = {
+        "pkg/a.py": (
+            "import threading\n"
+            "from pkg.b import Middle\n"
+            "class Outer:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._mid = Middle()\n"
+            "    def run(self):\n"
+            "        with self._lock:\n"
+            "            self._mid.step()\n"),
+        "pkg/b.py": (
+            "from pkg.c import Leaf\n"
+            "class Middle:\n"
+            "    def __init__(self):\n"
+            "        self._leaf = Leaf()\n"
+            "    def step(self):\n"
+            "        self._leaf.poke()\n"),
+        "pkg/c.py": (
+            "import threading\n"
+            "class Leaf:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def poke(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"),
+    }
+    facts = {}
+    for rel, code in src.items():
+        _, conc = analyze_concurrency(rel, ast.parse(code),
+                                      code.splitlines())
+        facts[rel] = {"conc": conc}
+    model = build_lock_model(facts)
+    pairs = runtime_pairs(model)
+    assert ("Outer._lock", "Leaf._lock") in pairs
+    assert ("Outer._lock", "Leaf._lock") not in model["expanded"]
+
+
+def test_runtime_pairs_names_inherited_locks_after_subclass():
+    """A subclass instance's inherited lock resolves at runtime under
+    the SUBCLASS name (even across modules) — the closure must emit it
+    that way or real observations read as model gaps."""
+    import ast
+
+    from dlrover_tpu.analysis.concurrency import (
+        analyze_concurrency,
+        build_lock_model,
+        runtime_pairs,
+    )
+
+    src = {
+        "pkg/base.py": (
+            "import threading\n"
+            "class Base:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def poke(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"),
+        "pkg/sub.py": (
+            "from pkg.base import Base\n"
+            "class Sub(Base):\n"
+            "    pass\n"),
+        "pkg/owner.py": (
+            "import threading\n"
+            "from pkg.sub import Sub\n"
+            "class Owner:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._sub = Sub()\n"
+            "    def run(self):\n"
+            "        with self._lock:\n"
+            "            self._sub.poke()\n"),
+    }
+    facts = {}
+    for rel, code in src.items():
+        _, conc = analyze_concurrency(rel, ast.parse(code),
+                                      code.splitlines())
+        facts[rel] = {"conc": conc}
+    pairs = runtime_pairs(build_lock_model(facts))
+    assert ("Owner._lock", "Sub._lock") in pairs
+
+
+# -- the tier-1 gate: observed ↔ static diff ---------------------------------
+
+def test_observed_acquisitions_match_static_model(tmp_path):
+    """Drive the snapshot path (the fleet's deepest lock nesting: the
+    cut exports every component's state under _snapshot_lock) with the
+    sanitizer installed, then diff the observed acquisition graph
+    against the static model: observed cycles, hot blocking, or edges
+    the model lacks all fail.  In-process on purpose — a nested pytest
+    would re-pay JAX startup for the same edges."""
+    import importlib.util
+
+    from dlrover_tpu.analysis import lockcheck
+    from dlrover_tpu.analysis.concurrency import runtime_pairs
+    from dlrover_tpu.common.config import Context
+
+    spec = importlib.util.spec_from_file_location(
+        "graftrace_cli", REPO / "tools" / "graftrace.py")
+    graftrace = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(graftrace)
+
+    # the session fixture owns the proxy when DLROVER_TPU_LOCKCHECK=1;
+    # only install/uninstall when running plain
+    owned = not lockcheck.installed()
+    if owned:
+        lockcheck.install()
+    try:
+        Context.singleton().update(
+            master_state_dir=str(tmp_path / "state"))
+        from dlrover_tpu.master.job_master import JobMaster
+
+        master = JobMaster(port=0, min_nodes=1, max_nodes=1)
+        master.kv_store.set("a", b"1")
+        master._maybe_snapshot()
+        master.kv_store.set("b", b"2")
+        master._maybe_snapshot()
+        master._server.stop(0)
+        rep = lockcheck.report()
+    finally:
+        if owned:
+            lockcheck.uninstall()
+        Context.reset()
+
+    assert rep["cycles"] == []
+    assert rep["hot_blocking"] == []
+    assert rep["edges"], "the snapshot cut must drive lock nesting"
+
+    model = graftrace.static_model([str(REPO / "dlrover_tpu")])
+    diff = lockcheck.observed_static_diff(
+        rep, runtime_pairs(model), coverage_pairs=model["expanded"])
+    assert diff["observed_not_modeled"] == [], (
+        "observed edges missing from the static model: "
+        f"{diff['observed_not_modeled']}")
+
+
+# -- regression: findings fixed in master/, obs/, agent/, data/ --------------
+
+def test_merge_paral_config_is_atomic_across_threads():
+    """GL701 flagged the tuner/RPC read-modify-write on _paral_config;
+    the fix serializes merges on _paral_lock.  N racing mergers must
+    bump the version exactly N times (a lost update would repeat one)."""
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    servicer = MasterServicer()
+    start = servicer.get_paral_config().version \
+        if hasattr(servicer, "get_paral_config") \
+        else servicer._paral_config.version
+    threads = [threading.Thread(
+        target=lambda: [servicer.merge_paral_config() for _ in range(25)])
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert servicer._paral_config.version == start + 8 * 25
+
+
+def test_state_backend_save_respects_fence_gate(tmp_path):
+    """GL703: a deposed master's saves must become no-ops — gate() True
+    returns None and writes nothing."""
+    from dlrover_tpu.master.state_backend import MasterStateBackend
+
+    backend = MasterStateBackend(str(tmp_path))
+    backend.gate = lambda: True
+    assert backend.save({"step": 1}) is None
+    assert backend.save_if_changed({"step": 1}) is None
+    assert backend.versions() == []
+    backend.gate = lambda: False
+    assert backend.save({"step": 1}) is not None
+    assert backend.versions() == [1]
+
+
+def test_tsdb_sidecar_save_respects_fence_gate(tmp_path):
+    """GL703: the sidecar checks the fence at the writer itself, not
+    only in the collector's flush cadence."""
+    from dlrover_tpu.obs.tsdb import TimeSeriesSidecar, TimeSeriesStore
+
+    store = TimeSeriesStore()
+    sidecar = TimeSeriesSidecar(str(tmp_path))
+    assert sidecar.save(store, gate=lambda: True) is False
+    assert not os.path.exists(sidecar.path)
+    assert sidecar.save(store, gate=lambda: False) is True
+    assert os.path.exists(sidecar.path)
+
+
+def test_get_restore_plan_stamps_envelope_epoch():
+    """GL704: the staleness guard compares the stamp ON the plan dict;
+    a plan parsed without one would always look fresh."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common import messages as msg
+
+    client = MasterClient("localhost:0")
+    client._get_typed = lambda req, typ: msg.RestorePlan(
+        found=True, plan_json=json.dumps({"donors": []}), epoch=7)
+    plan = client.get_restore_plan()
+    assert plan["epoch"] == 7
+    # an explicit stamp in the payload is authoritative over the envelope
+    client._get_typed = lambda req, typ: msg.RestorePlan(
+        found=True, plan_json=json.dumps({"epoch": 3}), epoch=7)
+    assert client.get_restore_plan()["epoch"] == 3
+
+
+def test_coworker_finished_flag_is_cross_thread_visible():
+    """GL701: _finished is a threading.Event (single False->True
+    transition read from RPC threads), not a bare bool."""
+    from dlrover_tpu.data.coworker import CoworkerDataService
+
+    svc = CoworkerDataService(port=0, host="127.0.0.1")
+    try:
+        assert isinstance(svc._finished, threading.Event)
+        t = threading.Thread(target=svc.mark_finished)
+        t.start()
+        assert svc._finished.wait(timeout=10.0)
+        t.join()
+    finally:
+        svc.stop(grace_s=0.1)

@@ -1026,7 +1026,10 @@ def test_slice_loss_acceptance_in_process(tmp_path):
                     30.0, "slice 0 to re-form")
         reform_s = time.time() - kill_ts
         status = mgr.slice_status()
-        assert status["slices"]["0"]["generation"] == 2
+        # the bump is >= 2, not == 2: the two replacement agents race
+        # the round cut, and the first may form a 1-node world that the
+        # second's arrival immediately re-cuts (an extra generation)
+        assert status["slices"]["0"]["generation"] >= 2
         assert status["slices"]["1"]["generation"] == 1
         assert agents[2]._proc.pid == survivor_pid
 

@@ -17,9 +17,16 @@ see docs/static_analysis.md for when (not) to regenerate it.
 
 Per-file results are cached in tools/.graftlint_cache.json keyed by
 (path, mtime_ns, size, rules-version); --no-cache forces a cold run.
+--jobs N fans the cold per-file analysis over a process pool (the warm
+path stays sequential: cache probes are I/O-bound, not CPU-bound).
+--changed analyzes only files git reports as modified — the pre-commit
+fast path (cross-module checks still pool facts from the cache, so run
+a full pass before trusting a --changed run on cross-file rules).
 The obs-catalog drift check (docs/observability.md ↔ emitted names)
 runs whenever the analyzed roots include the obs/ tree; --obs-doc
-points it at a different catalog (fixtures/tests).
+points it at a different catalog (fixtures/tests).  The lock-order
+hierarchy check (GL702 ↔ docs/fault_tolerance.md) gates the same way:
+whole-package runs diff the project lock graph against the doc table.
 """
 
 from __future__ import annotations
@@ -44,17 +51,50 @@ DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
 DEFAULT_CACHE = os.path.join(_REPO_ROOT, "tools",
                              ".graftlint_cache.json")
 DEFAULT_OBS_DOC = os.path.join(_REPO_ROOT, "docs", "observability.md")
+DEFAULT_LOCK_DOC = os.path.join(_REPO_ROOT, "docs",
+                                "fault_tolerance.md")
 
 
 def _roots_cover_obs(roots) -> bool:
     """The drift check needs the obs/ emitters in scope — a partial run
     over one module must not report half the catalog as dead."""
+    return _roots_cover(roots, "obs")
+
+
+def _roots_cover(roots, subdir: str) -> bool:
     for root in roots:
         absroot = os.path.abspath(root)
         if os.path.isdir(absroot) and os.path.isdir(
-                os.path.join(absroot, "obs")):
+                os.path.join(absroot, subdir)):
             return True
     return False
+
+
+def _changed_files(roots) -> list:
+    """Files git reports modified/added (worktree + index) under the
+    requested roots — the pre-commit fast path."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "--diff-filter=ACMR", "HEAD"],
+            cwd=_REPO_ROOT, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    if out.returncode != 0:
+        return []
+    rootset = [os.path.abspath(r) for r in roots]
+    picked = []
+    for rel in out.stdout.splitlines():
+        if not rel.endswith(".py"):
+            continue
+        path = os.path.join(_REPO_ROOT, rel)
+        if not os.path.isfile(path):
+            continue
+        if any(os.path.commonpath([path, r]) == r for r in rootset):
+            picked.append(path)
+    return picked
 
 
 def _github_escape(text: str) -> str:
@@ -92,6 +132,17 @@ def main(argv=None) -> int:
                         help="observability catalog for the drift check")
     parser.add_argument("--no-obs-drift", action="store_true",
                         help="skip the docs/observability.md drift check")
+    parser.add_argument("--lock-doc", default=DEFAULT_LOCK_DOC,
+                        help="lock-hierarchy table for the GL702 check")
+    parser.add_argument("--no-lock-order", action="store_true",
+                        help="skip the lock-hierarchy table diff "
+                             "(cycle detection still runs)")
+    parser.add_argument("--jobs", type=int, default=0, metavar="N",
+                        help="process-pool size for cold analysis "
+                             "(0 = cpu count, 1 = sequential)")
+    parser.add_argument("--changed", action="store_true",
+                        help="analyze only git-modified files under the "
+                             "roots (pre-commit fast path)")
     args = parser.parse_args(argv)
     if args.as_json:
         args.fmt = "json"
@@ -103,6 +154,12 @@ def main(argv=None) -> int:
         return 0
 
     roots = args.roots or [os.path.join(_REPO_ROOT, "dlrover_tpu")]
+    if args.changed:
+        changed = _changed_files(roots)
+        if not changed:
+            print("graftlint: no changed python files under the roots")
+            return 0
+        roots = changed
     baseline = None
     if not args.no_baseline and not args.write_baseline:
         try:
@@ -114,10 +171,17 @@ def main(argv=None) -> int:
     obs_doc = None
     if not args.no_obs_drift and _roots_cover_obs(roots):
         obs_doc = args.obs_doc
+    # the hierarchy diff needs the whole lock graph in scope: gate it
+    # the same way as the obs catalog (a --changed or single-module run
+    # would diff a partial graph and report the rest as stale rows)
+    lock_doc = None
+    if not args.no_lock_order and _roots_cover(roots, "master"):
+        lock_doc = args.lock_doc
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
     result = run_analysis(
         roots, baseline=baseline,
         cache_path=None if args.no_cache else args.cache,
-        obs_doc=obs_doc)
+        obs_doc=obs_doc, lock_doc=lock_doc, jobs=jobs)
 
     if args.write_baseline:
         if result.parse_errors:

@@ -1,0 +1,205 @@
+#!/usr/bin/env python3
+"""graftrace CLI — the fleet concurrency model, both halves.
+
+Usage:
+    python tools/graftrace.py                      # static model report
+    python tools/graftrace.py --markdown           # lock-hierarchy rows
+                                                   #   for docs/fault_tolerance.md
+    python tools/graftrace.py --diff DUMP.json     # observed ↔ static diff
+    python tools/graftrace.py --run [pytest args]  # run pytest under the
+                                                   #   lock sanitizer, then diff
+
+The static half pools the per-file GL702 facts (lock creations,
+acquired-while-held edges, thread spawns) into the project lock model;
+the runtime half (`dlrover_tpu/analysis/lockcheck.py`) records what the
+test suite actually does.  The diff is directional:
+
+- an **observed** edge the static model lacks is a model gap — the
+  analyzer is blind to a real nesting → exit 1;
+- a **modeled** edge never observed is a coverage gap — reported, not
+  failed (tests simply never drove that path);
+- observed cycles or blocking calls under a gradient-path lock always
+  fail.
+
+Exit codes: 0 clean, 1 findings (cycles / hot blocking / model gap),
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import subprocess
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from dlrover_tpu.analysis.concurrency import (        # noqa: E402
+    analyze_concurrency,
+    build_lock_model,
+    find_cycles,
+    runtime_pairs,
+)
+from dlrover_tpu.analysis.runner import (             # noqa: E402
+    iter_python_files,
+    package_relpath,
+)
+
+DEFAULT_ROOT = os.path.join(_REPO_ROOT, "dlrover_tpu")
+DEFAULT_DUMP = "/tmp/graftrace_lockcheck.json"
+
+
+def collect_facts(roots) -> dict:
+    """relpath -> {"conc": facts} for every parseable file under roots
+    (parse errors are skipped: graftlint owns reporting those)."""
+    facts_by_path = {}
+    for root in roots:
+        root = os.path.abspath(root)
+        files = iter_python_files(root) if os.path.isdir(root) \
+            else [(root, package_relpath(root))]
+        for path, relpath in files:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    source = fh.read()
+                tree = ast.parse(source, filename=relpath)
+            except (OSError, SyntaxError, ValueError):
+                continue
+            _, conc = analyze_concurrency(relpath, tree,
+                                          source.splitlines())
+            if conc:
+                facts_by_path[relpath] = {"conc": conc}
+    return facts_by_path
+
+
+def static_model(roots) -> dict:
+    return build_lock_model(collect_facts(roots))
+
+
+def _print_markdown(model: dict) -> None:
+    """Rows in exactly the shape `parse_lock_table` consumes: first two
+    columns backticked lock ids, the rest free commentary."""
+    print("| outer | inner | first site |")
+    print("| --- | --- | --- |")
+    for (outer, inner), site in sorted(
+            model["edges"].items(),
+            key=lambda kv: (kv[1]["path"], kv[1]["line"])):
+        print(f"| `{outer}` | `{inner}` | "
+              f"{site['path']}:{site['line']} |")
+
+
+def _print_report(model: dict) -> int:
+    print(f"graftrace: {len(model['locks'])} lock(s), "
+          f"{len(model['edges'])} labeled edge(s), "
+          f"{len(model['threads'])} thread spawn site(s)")
+    for lock_id, entry in sorted(model["locks"].items()):
+        print(f"  lock  {lock_id}  [{entry['kind']}]  {entry['path']}")
+    for (outer, inner), site in sorted(
+            model["edges"].items(),
+            key=lambda kv: (kv[1]["path"], kv[1]["line"])):
+        print(f"  edge  {outer} -> {inner}  "
+              f"{site['path']}:{site['line']}")
+    cycles = find_cycles(model["expanded"])
+    for cycle in cycles:
+        chain = " -> ".join(cycle + cycle[:1])
+        print(f"  CYCLE {chain}")
+    return 1 if cycles else 0
+
+
+def _diff_dump(model: dict, dump: dict) -> int:
+    from dlrover_tpu.analysis.lockcheck import observed_static_diff
+
+    status = 0
+    cycles = dump.get("cycles") or []
+    for cycle in cycles:
+        print("graftrace: OBSERVED lock cycle: "
+              + " -> ".join(cycle + cycle[:1]))
+        status = 1
+    hot = dump.get("hot_blocking") or []
+    for ev in hot:
+        print(f"graftrace: HOT BLOCKING {ev['func']} "
+              f"({ev['duration_s']:.4f}s) under "
+              f"{', '.join(ev['hot_held'])} at {ev['site']} "
+              f"[{ev['thread']}]")
+        status = 1
+    # model gaps diff against the class-call closure (multi-hop
+    # nestings under one outer lock are modeled); coverage gaps diff
+    # against the tight one-hop expansion only
+    diff = observed_static_diff(dump, runtime_pairs(model),
+                                coverage_pairs=model["expanded"])
+    for outer, inner in diff["observed_not_modeled"]:
+        print(f"graftrace: MODEL GAP observed edge {outer} -> {inner} "
+              f"is missing from the static lock model")
+        status = 1
+    for outer, inner in diff["modeled_not_observed"]:
+        print(f"graftrace: coverage gap: modeled edge {outer} -> "
+              f"{inner} never observed (tests did not drive it)")
+    for outer, inner in diff["unresolved_observed"]:
+        print(f"graftrace: unresolved edge {outer} -> {inner} "
+              f"(lock never matched an attribute; excluded from diff)")
+    n_obs = len(dump.get("edges") or ())
+    print(f"graftrace: {n_obs} observed edge(s), "
+          f"{len(diff['observed_not_modeled'])} model gap(s), "
+          f"{len(cycles)} cycle(s), {len(hot)} hot blocking event(s)")
+    return status
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftrace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("roots", nargs="*", default=[],
+                        help="package dirs to model (default: "
+                             "dlrover_tpu)")
+    parser.add_argument("--markdown", action="store_true",
+                        help="print the lock-hierarchy table rows for "
+                             "docs/fault_tolerance.md")
+    parser.add_argument("--diff", metavar="DUMP",
+                        help="diff a lockcheck JSON dump against the "
+                             "static model")
+    parser.add_argument("--run", nargs=argparse.REMAINDER,
+                        metavar="PYTEST_ARG",
+                        help="run pytest under DLROVER_TPU_LOCKCHECK=1, "
+                             "then diff the dump (remaining args go to "
+                             "pytest)")
+    parser.add_argument("--out", default=DEFAULT_DUMP,
+                        help="dump path for --run")
+    args = parser.parse_args(argv)
+
+    roots = args.roots or [DEFAULT_ROOT]
+    model = static_model(roots)
+
+    if args.markdown:
+        _print_markdown(model)
+        return 0
+
+    if args.run is not None:
+        env = dict(os.environ,
+                   DLROVER_TPU_LOCKCHECK="1",
+                   DLROVER_TPU_LOCKCHECK_OUT=args.out)
+        cmd = [sys.executable, "-m", "pytest"] + (
+            args.run or ["tests/", "-q", "-m", "not slow"])
+        print("graftrace: running:", " ".join(cmd))
+        proc = subprocess.run(cmd, cwd=_REPO_ROOT, env=env)
+        if proc.returncode != 0:
+            print(f"graftrace: pytest exited {proc.returncode}",
+                  file=sys.stderr)
+            return 1
+        args.diff = args.out
+
+    if args.diff:
+        try:
+            with open(args.diff, "r", encoding="utf-8") as fh:
+                dump = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"graftrace: cannot read dump: {e}", file=sys.stderr)
+            return 2
+        return _diff_dump(model, dump)
+
+    return _print_report(model)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
