@@ -155,20 +155,55 @@ def _fold_restore_fields(result: dict, restore_result: dict) -> None:
             result[key] = restore_result[key]
 
 
-def _timed_loop(step_fn, state, tok, tgt, warmup=2, steps=5):
+def _timed_loop(step_fn, state, tok, tgt, warmup=2, steps=5,
+                per_step=None):
     """Shared warmup + timed-window protocol. The float() host fetches
     force the full chain to execute — necessary under remote-execution
     backends (block_until_ready does not wait on the axon tunnel).
+    ``per_step`` (optional list) collects each timed step's dispatch
+    wall time for the critical-path fold — stamps only, no extra host
+    syncs, so the headline window is unchanged.
     Returns (state, seconds, warmup_loss, final_loss)."""
     for _ in range(max(warmup, 1)):   # >=1: warmup_loss needs a metrics
         state, metrics = step_fn(state, tok, tgt)
     warmup_loss = float(metrics["loss"])
     t0 = time.perf_counter()
     for _ in range(steps):
+        t_step = time.perf_counter()
         state, metrics = step_fn(state, tok, tgt)
+        if per_step is not None:
+            per_step.append(time.perf_counter() - t_step)
     final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
     return state, dt, warmup_loss, final_loss
+
+
+def _critical_path_summary(step_times) -> dict:
+    """The timed window folded through the fleet's steptrace solver
+    (master/steptrace.py pure helpers) — the SAME attribution shape the
+    master reports, so the bench JSON and the live dashboard speak one
+    vocabulary. One lane here: a single-process bench has no cross-slice
+    barrier, and the fold says so (wait fraction 0) instead of omitting
+    the field."""
+    from dlrover_tpu.master.steptrace import (
+        solve_group,
+        summarize_solved,
+    )
+
+    solved, t0 = [], 0.0
+    for step, dt in enumerate(step_times):
+        rec = {"step": step, "gen": 0, "slice": 0, "rank": 0,
+               "t0": t0, "off": 0.0, "err": 0.0,
+               "phases": [["compute", 0.0, float(dt)]], "peers": {}}
+        solved.append(solve_group(0, step, {0: rec}))
+        t0 += float(dt)
+    summary = summarize_solved(solved)
+    return {
+        "traced_steps": summary["steps"],
+        "dominant_gating_phase": summary["dominant_gating_phase"],
+        "cross_slice_wait_fraction": summary[
+            "cross_slice_wait_fraction"],
+    }
 
 
 def _model_flops_per_token(cfg, seq: int) -> float:
@@ -403,8 +438,10 @@ def _measure() -> dict:
     targets = rng.integers(0, cfg.vocab_size, (micro, seq), dtype=np.int32)
     tok, tgt = trainer.shard_batch(tokens, targets)
 
+    per_step: list = []
     _, dt, warmup_loss, final_loss = _timed_loop(
-        trainer.step, state, tok, tgt, warmup=warmup, steps=steps)
+        trainer.step, state, tok, tgt, warmup=warmup, steps=steps,
+        per_step=per_step)
     assert final_loss == final_loss, "NaN loss"
     if final_loss >= warmup_loss:
         # a ~10-step window on synthetic data is noisy; a non-descending
@@ -422,6 +459,7 @@ def _measure() -> dict:
         "seq": seq,
         "opt": opt_name,
         "on_tpu": on_tpu,
+        "critical_path": _critical_path_summary(per_step),
     }
 
 
@@ -500,6 +538,8 @@ def main() -> None:
         "elastic_restore_seconds": restore_s,
         "elastic_restore_seconds_at_scale": restore_scale_s,
     }
+    if headline.get("critical_path"):
+        result["critical_path"] = headline["critical_path"]
     # the at-scale restore is the number the <30 s target is about:
     # its breakdown wins when both ran
     _fold_restore_fields(result, restore_result)

@@ -206,6 +206,11 @@ def run_bench() -> dict:
         # calibration.py; >= 2 distinct mesh shapes — base, shrink,
         # grow — each with its own predicted-vs-measured row)
         calibration = master.plan_calibration.table()
+        # the fleet's critical-path attribution over everything this
+        # run traced (master/steptrace.py): single-slice here, so the
+        # interesting numbers are the dominant gating phase and that
+        # the cross-slice wait is honestly ~0
+        steptrace = master.steptrace.summary()
         return {
             "metric": "replan_time_to_first_step_seconds",
             "value": headline,
@@ -220,6 +225,15 @@ def run_bench() -> dict:
             "calibration": calibration,
             "axis_discounts": master.plan_calibration.axis_discounts(
                 min_samples=1),
+            "critical_path": {
+                "traced_steps": steptrace.get("steps", 0),
+                "dominant_gating_rank": steptrace.get(
+                    "dominant_gating_rank", -1),
+                "dominant_gating_phase": steptrace.get(
+                    "dominant_gating_phase", ""),
+                "cross_slice_wait_fraction": steptrace.get(
+                    "cross_slice_wait_fraction", -1.0),
+            },
             "workdir": workdir,
         }
     finally:

@@ -731,13 +731,36 @@ class MasterClient:
                                  msg.GoodputReport)
         return self._json_dict(result.report_json)
 
-    def report_telemetry(self, samples=None, spans=None) -> bool:
-        """Push metric samples + finished span dicts to the master's
-        registry/flight recorder (obs/). Best-effort by contract: callers
+    def query_steptrace(self, start_step: int = -1, end_step: int = -1,
+                        last_n: int = 0) -> dict:
+        """Assembled per-step critical paths from the master's
+        StepTraceAssembler (master/steptrace.py): {"version", "steps",
+        "summary"}. {} = master predates steptrace."""
+        result = self._get_typed(msg.StepTraceRequest(
+            start_step=start_step, end_step=end_step, last_n=last_n),
+            msg.StepTraceResult)
+        return self._json_dict(result.result_json)
+
+    def probe_clock(self) -> float:
+        """One NTP-style clock probe: the master's wall clock, or -1.0
+        on failure / a master that predates ClockProbe. Deliberately a
+        single attempt on the RAW path — retry_rpc's backoff between
+        attempts would inflate the measured RTT, which IS the
+        uncertainty bound ClockSync stamps into records."""
+        try:
+            result = self._get(msg.ClockProbe(node_id=self.node_id))
+        except Exception:  # noqa: BLE001 — droppable by contract
+            return -1.0
+        return float(getattr(result, "server_ts", -1.0) or -1.0)
+
+    def report_telemetry(self, samples=None, spans=None,
+                         steptrace=None) -> bool:
+        """Push metric samples + finished span dicts + per-step trace
+        records to the master (obs/). Best-effort by contract: callers
         treat a False/raise as droppable telemetry."""
         import json
 
-        if not samples and not spans:
+        if not samples and not spans and not steptrace:
             return True
         return self._report(msg.TelemetryReport(
             node_id=self.node_id,
@@ -745,6 +768,7 @@ class MasterClient:
             node_type=self.node_type,
             samples=list(samples or ()),
             spans_json=json.dumps(spans) if spans else "",
+            steptrace_json=json.dumps(steptrace) if steptrace else "",
         )).success
 
     def get_paral_config(self) -> msg.ParallelConfig:

@@ -190,6 +190,25 @@ class Context:
         )
         # step-hang watchdog (trainer/watchdog.py); 0 = disabled
         self.hang_watchdog_s: float = DefaultValues.HANG_WATCHDOG_S
+        # per-step critical-path tracing (obs/steptrace.py +
+        # master/steptrace.py): worker record ring + clock-probe
+        # cadence, master assembly ring, and the CriticalPathRule
+        # gating-fraction threshold (0 disables the rule)
+        self.steptrace_enabled: bool = DefaultValues.STEPTRACE_ENABLED
+        self.steptrace_ring: int = DefaultValues.STEPTRACE_RING
+        self.steptrace_probe_interval_s: float = (
+            DefaultValues.STEPTRACE_PROBE_INTERVAL_S
+        )
+        self.steptrace_ring_steps: int = (
+            DefaultValues.STEPTRACE_RING_STEPS
+        )
+        self.critical_path_gating_fraction: float = (
+            DefaultValues.CRITICAL_PATH_GATING_FRACTION
+        )
+        # flight-recorder rings (obs/flight_recorder.py): per-process
+        # event ring + span-id dedup ring capacities
+        self.flight_ring_events: int = DefaultValues.FLIGHT_RING_EVENTS
+        self.flight_ring_spans: int = DefaultValues.FLIGHT_RING_SPANS
         # per-rank relaunch backoff + quarantine (agent/elastic_agent.py)
         self.relaunch_backoff_base_s: float = (
             DefaultValues.RELAUNCH_BACKOFF_BASE_S

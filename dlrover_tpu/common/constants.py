@@ -420,6 +420,31 @@ class DefaultValues:
     # scheme quant_collectives puts on the wire in-program); 0 = exact
     # float32 bytes
     DCN_SYNC_QUANT_BITS = 0
+    # -- per-step critical-path tracing (obs/steptrace.py) --------------
+    # worker-side: emit one compact trace record per step, batched over
+    # the TelemetryReport channel; False turns the recorder off (the
+    # StepTimeline windowed export keeps running either way)
+    STEPTRACE_ENABLED = True
+    # bounded drop-oldest record ring between flushes (a wedged master
+    # must not grow worker memory)
+    STEPTRACE_RING = 512
+    # NTP-style clock-offset refresh cadence against the master (the
+    # join-time probe always runs; refreshes ride the report cadence)
+    STEPTRACE_PROBE_INTERVAL_S = 30.0
+    # master-side: assembled (gen, step) groups the StepTraceAssembler
+    # retains for queries / the flight embed
+    STEPTRACE_RING_STEPS = 512
+    # CriticalPathRule: flag a rank after it gated at least this
+    # fraction of the window's solved steps for
+    # STRAGGLER_TRIGGER_WINDOWS consecutive evaluations (clears after
+    # STRAGGLER_CLEAR_WINDOWS under — the same hysteresis knobs as
+    # StragglerRule); 0 disables the rule
+    CRITICAL_PATH_GATING_FRACTION = 0.5
+    # -- flight recorder rings (obs/flight_recorder.py) -----------------
+    # per-process bounded event ring and span-id dedup ring (historically
+    # one hard-coded 4096)
+    FLIGHT_RING_EVENTS = 4096
+    FLIGHT_RING_SPANS = 4096
     # -- per-rank relaunch backoff + quarantine (agent) -----------------
     # exponential delay between worker relaunches: base * 2^(k-1) for the
     # k-th recent failure, capped — a flapping worker must not hot-loop

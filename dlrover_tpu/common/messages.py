@@ -697,6 +697,9 @@ class TelemetryReport(Message):
     node_type: str = ""
     samples: List[MetricSample] = field(default_factory=list)
     spans_json: str = ""
+    # batched per-step trace records (obs/steptrace.py record dicts),
+    # same JSON-in-string convention as spans_json; "" = none
+    steptrace_json: str = ""
 
 
 # --------------------------------------------------------------------------
@@ -783,6 +786,42 @@ class GoodputRequest(Message):
 @dataclass
 class GoodputReport(Message):
     report_json: str = ""        # JSON GoodputLedger.snapshot() dict
+
+
+@dataclass
+class ClockProbe(Message):
+    """One NTP-style clock probe (obs/steptrace.py ClockSync): the
+    worker wraps this round trip in local wall-clock reads and estimates
+    its offset against the master from the midpoint. The servicer
+    answers immediately with its wall clock — no locks, no state — so
+    the RTT (the uncertainty bound) stays honest."""
+
+    node_id: int = -1
+
+
+@dataclass
+class ClockProbeResult(Message):
+    server_ts: float = 0.0       # master wall clock; <= 0 = unsupported
+
+
+@dataclass
+class StepTraceRequest(Message):
+    """tools/steptrace.py (or top.py) asking the master's
+    StepTraceAssembler for assembled per-step critical paths.
+    ``start_step``/``end_step`` bound the range inclusively (-1 = open);
+    ``last_n`` > 0 instead returns the newest N solved steps."""
+
+    start_step: int = -1
+    end_step: int = -1
+    last_n: int = 0
+
+
+@dataclass
+class StepTraceResult(Message):
+    """JSON StepTraceAssembler.query_payload dict ({"version", "steps",
+    "summary"}). "" = master has no assembler (predates steptrace)."""
+
+    result_json: str = ""
 
 
 # --------------------------------------------------------------------------

@@ -55,7 +55,8 @@ _STATS_FRESH_S = 120.0
 
 class DiagnosisManager:
     def __init__(self, speed_monitor, rules: Optional[List[Rule]] = None,
-                 goodput_ledger=None, plan_calibration=None):
+                 goodput_ledger=None, plan_calibration=None,
+                 steptrace=None):
         self._speed_monitor = speed_monitor
         self._rules = rules if rules is not None else default_rules()
         # optional goodput ledger (obs/goodput.py): its trailing-window
@@ -65,6 +66,10 @@ class DiagnosisManager:
         # running plan's predicted-vs-measured entry is the
         # PlanRegressionRule's evidence
         self._plan_calibration = plan_calibration
+        # optional steptrace assembler (master/steptrace.py): its
+        # windowed critical-path summary is the CriticalPathRule's
+        # evidence
+        self._steptrace = steptrace
         self._lock = threading.Lock()
         self._diag_lock = threading.Lock()
         self._reports: deque = deque(maxlen=_REPORT_RING)
@@ -311,6 +316,12 @@ class DiagnosisManager:
                 calibration = self._plan_calibration.current()
             except Exception:  # noqa: BLE001 — evidence, not the chain
                 logger.exception("plan calibration read failed")
+        steptrace = None
+        if self._steptrace is not None:
+            try:
+                steptrace = self._steptrace.summary()
+            except Exception:  # noqa: BLE001 — evidence, not the chain
+                logger.exception("steptrace summary read failed")
         return DiagnosisSnapshot(
             ts=now,
             worker_speeds=self._speed_monitor.worker_speeds(),
@@ -322,6 +333,7 @@ class DiagnosisManager:
             peak_mfu=self._speed_monitor.peak_mfu(),
             goodput=goodput,
             plan_calibration=calibration,
+            steptrace=steptrace,
         )
 
     def diagnose_once(self) -> List[DiagnosisReport]:

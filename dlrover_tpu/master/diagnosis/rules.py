@@ -68,6 +68,12 @@ class DiagnosisSnapshot:
     # {"mesh", "predicted_step_s", "measured_step_s", "ratio",
     #  "samples", ...}; None = no calibration attached / no plan yet
     plan_calibration: Optional[Dict[str, Any]] = None
+    # windowed critical-path attribution (master/steptrace.py
+    # StepTraceAssembler.summary): {"steps", "by_rank": {rank_str:
+    # {"gating_steps", "gating_s", "phases"}}, "dominant_gating_rank",
+    # "dominant_gating_phase", "cross_slice_wait_fraction"}; None = no
+    # assembler attached / nothing traced yet
+    steptrace: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -488,9 +494,116 @@ class PlanRegressionRule(Rule):
         return []
 
 
+class CriticalPathRule(Rule):
+    """Steptrace critical-path attribution: a rank that GATES the fleet
+    step — the one every other rank was waiting on — for at least
+    ``critical_path_gating_fraction`` of the traced window is flagged by
+    the *seconds it cost*, not by a mean ratio. This is sharper than
+    :class:`StragglerRule`: a rank can have an unremarkable mean step
+    time and still gate every step (it is last by a little, every
+    time), and the evidence names the PHASE that gated (compute vs
+    data_wait vs checkpoint), so the profile request already knows what
+    it is looking for. Hysteresis mirrors StragglerRule
+    (``straggler_trigger_windows`` to flag,
+    ``straggler_clear_windows`` to clear); disabled when the fraction
+    knob is <= 0 or the window has fewer than
+    ``diagnosis_min_worker_samples`` traced steps."""
+
+    name = "critical_path"
+
+    def __init__(self):
+        self._over: Dict[int, int] = {}     # consecutive over-threshold
+        self._under: Dict[int, int] = {}    # consecutive clean (flagged)
+        self._flagged: set = set()
+
+    def evaluate(self, snapshot, ctx=None):
+        ctx = ctx or Context.singleton()
+        threshold = ctx.critical_path_gating_fraction
+        evidence = snapshot.steptrace
+        if threshold <= 0.0 or not evidence:
+            return []
+        steps = int(evidence.get("steps", 0))
+        if steps < ctx.diagnosis_min_worker_samples:
+            return []
+        by_rank = evidence.get("by_rank", {}) or {}
+        reports: List[DiagnosisReport] = []
+        live = set()
+        for rank_key, entry in by_rank.items():
+            try:
+                worker_id = int(rank_key)
+            except (TypeError, ValueError):
+                continue
+            live.add(worker_id)
+            gating_steps = int(entry.get("gating_steps", 0))
+            gating_s = float(entry.get("gating_s", 0.0))
+            fraction = gating_steps / steps
+            phases = entry.get("phases", {}) or {}
+            dominant_phase = max(
+                sorted(phases), key=lambda p: phases[p],
+                default="unknown")
+            if fraction >= threshold:
+                self._under.pop(worker_id, None)
+                count = self._over.get(worker_id, 0) + 1
+                self._over[worker_id] = count
+                if (worker_id not in self._flagged
+                        and count >= ctx.straggler_trigger_windows):
+                    self._flagged.add(worker_id)
+                    reports.append(DiagnosisReport(
+                        rule=self.name, severity=WARNING,
+                        worker_id=worker_id,
+                        summary=(
+                            f"rank {worker_id} gated {gating_steps}/"
+                            f"{steps} traced steps "
+                            f"({dominant_phase}, {gating_s:.2f}s "
+                            f"gating)"),
+                        details={
+                            "gating_steps": gating_steps,
+                            "traced_steps": steps,
+                            "gating_fraction": round(fraction, 3),
+                            "gating_s": round(gating_s, 4),
+                            "gating_phase": dominant_phase,
+                            "phases": {p: round(float(s), 4)
+                                       for p, s in phases.items()},
+                            "windows_over": count},
+                        actions=[f"{ACTION_PROFILE}:{worker_id}",
+                                 ACTION_ALERT],
+                    ))
+            else:
+                self._over.pop(worker_id, None)
+                if worker_id in self._flagged:
+                    count = self._under.get(worker_id, 0) + 1
+                    self._under[worker_id] = count
+                    if count >= ctx.straggler_clear_windows:
+                        self._flagged.discard(worker_id)
+                        self._under.pop(worker_id, None)
+                        reports.append(DiagnosisReport(
+                            rule=self.name, severity=INFO,
+                            worker_id=worker_id,
+                            summary=(
+                                f"rank {worker_id} off the critical "
+                                f"path: gated {gating_steps}/{steps} "
+                                f"traced steps"),
+                            details={"gating_fraction": round(
+                                fraction, 3)},
+                            actions=[ACTION_OBSERVE],
+                        ))
+        # evidence for departed ranks must not linger (a re-joining rank
+        # would inherit a half-accumulated hysteresis count)
+        for table in (self._over, self._under):
+            for worker_id in list(table):
+                if worker_id not in live:
+                    table.pop(worker_id, None)
+        self._flagged &= live | {r.worker_id for r in reports}
+        return reports
+
+    @property
+    def flagged(self) -> set:
+        return set(self._flagged)
+
+
 def default_rules() -> List[Rule]:
     """The chain, cheapest-evidence first."""
-    return [StragglerRule(), DataPipelineBoundRule(),
+    return [StragglerRule(), CriticalPathRule(), DataPipelineBoundRule(),
             ThroughputCollapseRule(), HbmPressureRule(),
             PlanRegressionRule(), GoodputRule()]
 

@@ -100,6 +100,12 @@ class JobMaster:
         from dlrover_tpu.parallel.calibration import PlanCalibration
 
         self.plan_calibration = PlanCalibration()
+        # per-step critical-path assembly (master/steptrace.py): joins
+        # worker trace records, feeds the tsdb gating series, evidences
+        # CriticalPathRule, serves tools/steptrace.py + the flight embed
+        from dlrover_tpu.master.steptrace import StepTraceAssembler
+
+        self.steptrace = StepTraceAssembler(tsdb=self.tsdb)
         self.diagnosis_manager = None
         if ctx.diagnosis_enabled:
             from dlrover_tpu.master.diagnosis import DiagnosisManager
@@ -107,7 +113,8 @@ class JobMaster:
             self.diagnosis_manager = DiagnosisManager(
                 self.speed_monitor,
                 goodput_ledger=self.goodput_ledger,
-                plan_calibration=self.plan_calibration)
+                plan_calibration=self.plan_calibration,
+                steptrace=self.steptrace)
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             rdzv_managers=self.rdzv_managers,
@@ -120,6 +127,7 @@ class JobMaster:
             goodput_ledger=self.goodput_ledger,
             tsdb=self.tsdb,
             plan_calibration=self.plan_calibration,
+            steptrace=self.steptrace,
         )
         if self.diagnosis_manager is not None:
             # learned-discount feedback rides the diagnosis cadence,
@@ -741,6 +749,15 @@ class JobMaster:
                         .axis_discounts())
                 except Exception:  # noqa: BLE001 — the dump must land
                     logger.exception("tsdb flight snapshot failed")
+            try:
+                # the assembled waterfall rides in the dump so
+                # `tools/steptrace.py --flight` renders the exact
+                # payload the live RPC served
+                obs.get_flight_recorder().record_event(
+                    "steptrace",
+                    snapshot=self.steptrace.flight_snapshot())
+            except Exception:  # noqa: BLE001 — the dump must land
+                logger.exception("steptrace flight snapshot failed")
             obs.get_flight_recorder().record_event(
                 "master_stop", exit_reason=self._exit_reason)
             obs.get_flight_recorder().dump(reason="master-stop")

@@ -66,6 +66,7 @@ class MasterServicer:
         goodput_ledger=None,
         tsdb=None,
         plan_calibration=None,
+        steptrace=None,
     ):
         self.task_manager = task_manager or TaskManager()
         self.rdzv_managers: Dict[str, RendezvousManager] = rdzv_managers or {
@@ -92,6 +93,10 @@ class MasterServicer:
         # stamped plans register predictions, step reports register
         # measurements, learned discounts push back into the planner
         self.plan_calibration = plan_calibration
+        # optional: the step-trace assembler (master/steptrace.py) —
+        # fed batched per-step records from telemetry reports, queried
+        # by tools/steptrace.py + top.py
+        self.steptrace = steptrace
         self._pushed_discounts: Dict[str, float] = {}
         # the tuned config is read on RPC threads and merged from the
         # auto-scaler thread: every access goes through _paral_lock or
@@ -225,6 +230,21 @@ class MasterServicer:
                 resolution_s=request.resolution_s)
             return msg.TimeSeriesResult(
                 result_json=json.dumps(payload))
+        if isinstance(request, msg.ClockProbe):
+            # answered inline with no locks and no state: the RTT the
+            # client measures around this IS its uncertainty bound —
+            # queueing here would inflate every stamped error bar
+            return msg.ClockProbeResult(server_ts=time.time())
+        if isinstance(request, msg.StepTraceRequest):
+            import json
+
+            if self.steptrace is None:
+                return msg.StepTraceResult(result_json="")
+            return msg.StepTraceResult(result_json=json.dumps(
+                self.steptrace.query_payload(
+                    start_step=request.start_step,
+                    end_step=request.end_step,
+                    last_n=request.last_n)))
         if isinstance(request, msg.PlanCalibrationRequest):
             import json
 
@@ -890,7 +910,7 @@ class MasterServicer:
             except json.JSONDecodeError:
                 logger.warning("telemetry spans from node %d undecodable",
                                report.node_id)
-                return
+                spans = None
             if isinstance(spans, list):
                 obs.record_remote_spans(spans, registry)
                 if self.goodput_ledger is not None:
@@ -898,6 +918,18 @@ class MasterServicer:
                         if isinstance(record, dict):
                             self.goodput_ledger.observe_span(
                                 record, rank=report.node_rank)
+        if getattr(report, "steptrace_json", "") and \
+                self.steptrace is not None:
+            try:
+                records = json.loads(report.steptrace_json)
+            except json.JSONDecodeError:
+                logger.warning(
+                    "steptrace batch from node %d undecodable",
+                    report.node_id)
+                return
+            if isinstance(records, list):
+                self.steptrace.ingest(records,
+                                      node_rank=report.node_rank)
 
     # ------------------------------------------------------------------
     def _evict_departed(self, mgr) -> None:
@@ -910,6 +942,8 @@ class MasterServicer:
             self.diagnosis_manager.evict_workers(live)
         if self.goodput_ledger is not None:
             self.goodput_ledger.evict(live)
+        if self.steptrace is not None:
+            self.steptrace.evict_departed(live)
 
     # ------------------------------------------------------------------
     def _touch_rendezvous(self, node_rank: int) -> None:

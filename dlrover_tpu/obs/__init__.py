@@ -57,6 +57,12 @@ from dlrover_tpu.obs.spans import (
     remove_span_sink,
     span,
 )
+from dlrover_tpu.obs.steptrace import (
+    TRACE_PHASES,
+    ClockSync,
+    StepTraceRecorder,
+    phase_seconds,
+)
 from dlrover_tpu.obs.timeline import StepTimeline, load_timeline
 from dlrover_tpu.obs.tsdb import (
     TimeSeriesSidecar,
@@ -69,6 +75,8 @@ __all__ = [
     "BUCKETS",
     "DEFAULT_BUCKETS",
     "FLIGHT_DIR_ENV",
+    "TRACE_PHASES",
+    "ClockSync",
     "DeviceTelemetry",
     "FlightRecorder",
     "GoodputLedger",
@@ -78,6 +86,7 @@ __all__ = [
     "Span",
     "SpanExporter",
     "StepTimeline",
+    "StepTraceRecorder",
     "TimeSeriesSidecar",
     "TimeSeriesStore",
     "TsdbCollector",
@@ -89,6 +98,7 @@ __all__ = [
     "get_registry",
     "load_timeline",
     "mfu",
+    "phase_seconds",
     "publish_node_stats",
     "read_profile_result",
     "record_remote_spans",

@@ -36,18 +36,40 @@ FLIGHT_DIR_ENV = "DLROVER_TPU_FLIGHT_DIR"
 _DEFAULT_CAPACITY = 4096
 
 
+def _context_capacities() -> tuple:
+    """(event_ring, span_dedup_ring) from the Context knobs
+    ``flight_ring_events`` / ``flight_ring_spans`` (env-overridable like
+    every knob). obs/ stays importable without the config layer — any
+    failure falls back to the historical 4096."""
+    try:
+        from dlrover_tpu.common.config import Context
+
+        ctx = Context.singleton()
+        return (max(1, int(ctx.flight_ring_events)),
+                max(1, int(ctx.flight_ring_spans)))
+    except Exception:  # noqa: BLE001 — telemetry must never raise
+        return _DEFAULT_CAPACITY, _DEFAULT_CAPACITY
+
+
 class FlightRecorder:
-    def __init__(self, capacity: int = _DEFAULT_CAPACITY, role: str = "",
-                 dump_dir: str = ""):
+    def __init__(self, capacity: Optional[int] = None, role: str = "",
+                 dump_dir: str = "", span_capacity: Optional[int] = None):
         # REENTRANT: the SIGTERM handler records + dumps on the very
         # thread it interrupted, which may already hold this lock (every
         # span dispatch appends here) — a plain Lock would deadlock the
         # process in exactly the platform-termination window
         self._lock = threading.RLock()
-        self._events: deque = deque(maxlen=capacity)
+        ctx_events, ctx_spans = _context_capacities()
+        if capacity is None:
+            capacity = ctx_events
+        if span_capacity is None:
+            # an explicit event capacity (tests sizing tiny rings) keeps
+            # the historical behavior of sizing both rings together
+            span_capacity = capacity if capacity != ctx_events else ctx_spans
+        self._events: deque = deque(maxlen=max(1, capacity))
         # span ids already recorded: a standalone master+agent process
         # sees its own spans twice (local sink + telemetry relay)
-        self._seen_span_ids: deque = deque(maxlen=capacity)
+        self._seen_span_ids: deque = deque(maxlen=max(1, span_capacity))
         self._seen_set: set = set()
         self._role = role or os.environ.get(
             "DLROVER_TPU_NODE_TYPE", "process")

@@ -499,6 +499,9 @@ DASHBOARD_SERIES = (
     "dlrover_tpu_slice_workers",
     "dlrover_tpu_worker_hbm_peak_mb",
     "dlrover_tpu_node_hbm_used_mb",
+    "dlrover_tpu_steptrace_gating_rank",
+    "dlrover_tpu_steptrace_gating_seconds",
+    "dlrover_tpu_steptrace_cross_slice_wait_fraction",
 )
 
 

@@ -220,6 +220,10 @@ class SliceGradSync:
         # legacy master): namespaces every dcn/ key so payloads from a
         # previous membership episode are unreachable by construction
         self._epoch = -1
+        # per-step cross-slice timing marks for the last reduce() —
+        # steptrace evidence, consumed via info["trace"]
+        # graftlint: ephemeral(per-step telemetry, rebuilt every reduce)
+        self._last_peer_obs: Dict[int, float] = {}
         registry = obs.get_registry()
         self._degraded_counter = registry.counter(
             "dlrover_tpu_slice_degraded_steps_total",
@@ -270,6 +274,12 @@ class SliceGradSync:
             except (TypeError, ValueError, AttributeError):
                 continue
         return out
+
+    @property
+    def world_epoch(self) -> int:
+        """The membership episode the master last reported (-1 =
+        unknown / legacy master) — steptrace records group under it."""
+        return self._epoch
 
     # -- keys ---------------------------------------------------------------
     def _ns(self, suffix: str) -> str:
@@ -449,6 +459,8 @@ class SliceGradSync:
         handoffs with the current pre-update state."""
         from dlrover_tpu.common.config import Context
 
+        t_ready = self._clock()   # gradients in hand, exchange begins
+        self._last_peer_obs = {}
         ctx = Context.singleton()
         status = self._status()
         formed = self._formed_slices(status)
@@ -480,6 +492,9 @@ class SliceGradSync:
                             degraded=True)
                 self._note_degraded(step, ["unknown"],
                                     self._last_known_total)
+            now = self._clock()
+            info["trace"] = {"grads_ready": t_ready, "local_post": t_ready,
+                             "collect_done": now, "peers": {}}
             return leaves, info
         self._last_known_total = total
         formed.setdefault(self.slice_id, True)
@@ -500,6 +515,7 @@ class SliceGradSync:
                              encode_leaves(
                                  leaves, step,
                                  quant_bits=ctx.dcn_sync_quant_bits))
+        t_post = self._clock()    # local contribution on the wire
         contributions: List[List[np.ndarray]] = [
             [np.asarray(leaf, np.float32) for leaf in leaves]]
         expected = sorted(sid for sid, ok in formed.items()
@@ -518,6 +534,12 @@ class SliceGradSync:
                         | set(missing))
         info.update(present=present, absent=absent,
                     degraded=len(present) < total)
+        # the steptrace decomposition: grads-ready → local-post →
+        # per-peer-header-observed → last-peer (collect done); clock()
+        # reads only — nothing here blocks or takes a lock
+        info["trace"] = {"grads_ready": t_ready, "local_post": t_post,
+                         "collect_done": self._clock(),
+                         "peers": dict(self._last_peer_obs)}
         if info["degraded"]:
             self._note_degraded(step, absent, total)
         else:
@@ -545,6 +567,9 @@ class SliceGradSync:
                 if posted == step:
                     decoded = decode_payload(raw)
                     if decoded is not None:
+                        # steptrace: when this peer's header for the
+                        # step was first observed (the join's input edge)
+                        self._last_peer_obs[sid] = self._clock()
                         collected[sid] = decoded[1]
                         pending.discard(sid)
                 elif posted > step:

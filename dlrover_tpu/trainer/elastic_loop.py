@@ -239,6 +239,29 @@ class ElasticTrainLoop:
             rank=int(os.environ.get(NodeEnv.NODE_RANK, "-1")))
         self._timeline_path = os.environ.get(NodeEnv.TIMELINE_FILE, "")
         self._timeline_exported_at = 0.0
+        # per-step critical-path trace (obs/steptrace.py): one compact
+        # record per step, clock-aligned against the master and batched
+        # over the telemetry channel; the join-time probe anchors the
+        # offset before the first step, report-cadence refreshes keep
+        # the drift allowance small
+        from dlrover_tpu.common.config import Context as _TraceCtx
+
+        _trace_ctx = _TraceCtx.singleton()
+        self._clock_sync = obs.ClockSync(
+            probe_fn=(self.client.probe_clock
+                      if self.client is not None else None))
+        self._steptrace = (
+            obs.StepTraceRecorder(
+                capacity=_trace_ctx.steptrace_ring,
+                rank=int(os.environ.get(NodeEnv.NODE_RANK, "-1")),
+                slice_id=self._slice_id,
+                clock_sync=self._clock_sync)
+            if _trace_ctx.steptrace_enabled else None)
+        if self._steptrace is not None and self.client is not None:
+            self._clock_sync.probe()
+        # SliceGradSync's per-reduce marks, stashed by _slice_step for
+        # the record built at the step boundary
+        self._last_sync_trace: Optional[Dict[str, Any]] = None
         # profiler: static window (config) + on-demand captures the
         # agent requests on behalf of a master `profile:{rank}` action
         self.profiler = obs.ProfilerSession(
@@ -1026,6 +1049,9 @@ class ElasticTrainLoop:
                                 t_compute_end - t_data),
                 checkpoint=ckpt_s,
             )
+            if self._steptrace is not None:
+                self._record_steptrace(step, t_step, t_data,
+                                       t_compute_end, ckpt_s)
             if (self.client is not None
                     and step % config.report_interval_steps == 0):
                 self._report_progress(step)
@@ -1092,9 +1118,76 @@ class ElasticTrainLoop:
         ])
         state, apply_metrics = self.trainer.apply_grads(state,
                                                         fleet_grads)
+        if self._steptrace is not None and info.get("trace"):
+            import time as _time
+
+            # the sync's clock() marks share the loop's monotonic
+            # domain; apply-dispatch end completes the decomposition
+            stashed = dict(info["trace"])
+            stashed["apply_done"] = _time.monotonic()
+            self._last_sync_trace = stashed
         raw_metrics = dict(raw_metrics)
         raw_metrics.update(apply_metrics)
         return state, raw_metrics
+
+    def _trace_generation(self) -> int:
+        """The membership episode steptrace records group under: the
+        world epoch the slice sync saw last, else the applied plan's
+        epoch, else 0 (static single-slice world)."""
+        if self._slice_sync is not None:
+            epoch = self._slice_sync.world_epoch
+            if epoch >= 0:
+                return epoch
+        if self._shard_plan is not None:
+            try:
+                return int(self._shard_plan.get("epoch", 0) or 0)
+            except (TypeError, ValueError):
+                pass
+        return 0
+
+    def _record_steptrace(self, step: int, t_step: float, t_data: float,
+                          t_compute_end: float, ckpt_s: float) -> None:
+        """Build one per-step trace record from the loop's monotonic
+        marks (+ the stashed SliceGradSync decomposition). Hot path:
+        a handful of float ops and one bounded-ring append."""
+        import time as _time
+
+        now_mono = _time.monotonic()
+        # local wall-clock anchor for the step start, derived from the
+        # same monotonic domain as every mark (a wall-clock step between
+        # t_step and now lands in the offset estimate, not the phases)
+        t0_wall = _time.time() - (now_mono - t_step)
+        data_d = max(0.0, t_data - t_step)
+        h2d_d = max(0.0, float(getattr(self.trainer,
+                                       "last_shard_batch_s", 0.0)))
+        phases = [("data_wait", 0.0, data_d), ("h2d", data_d, h2d_d)]
+        cursor = data_d + h2d_d
+        peers = None
+        stashed, self._last_sync_trace = self._last_sync_trace, None
+        if stashed is not None:
+            ready = stashed.get("grads_ready", t_compute_end) - t_step
+            post = max(ready, stashed.get("local_post", 0.0) - t_step)
+            coll = max(post, stashed.get("collect_done", 0.0) - t_step)
+            apply_end = max(coll,
+                            stashed.get("apply_done", t_compute_end)
+                            - t_step)
+            phases.append(("compute", cursor, max(0.0, ready - cursor)))
+            phases.append(("local_post", ready, post - ready))
+            phases.append(("cross_slice_wait", post, coll - post))
+            phases.append(("apply", coll, apply_end - coll))
+            cursor = max(cursor, apply_end)
+            raw_peers = stashed.get("peers") or {}
+            if raw_peers:
+                peers = {sid: max(0.0, t - t_step)
+                         for sid, t in raw_peers.items()}
+        else:
+            compute_end = max(cursor, t_compute_end - t_step)
+            phases.append(("compute", cursor, compute_end - cursor))
+            cursor = compute_end
+        if ckpt_s > 0:
+            phases.append(("checkpoint", cursor, ckpt_s))
+        self._steptrace.record(step, self._trace_generation(), t0_wall,
+                               phases, peers=peers)
 
     def _maybe_slice_catch_up(self, state, start_step: int, sampler
                               ) -> Tuple[Any, int]:
@@ -1267,6 +1360,13 @@ class ElasticTrainLoop:
             self.timeline.export(
                 self._timeline_path,
                 last_n=2 * self.config.report_interval_steps)
+        if self._steptrace is not None and self.client is not None:
+            # periodic clock refresh rides the report cadence (one RPC,
+            # rate-limited by the probe interval — never per step)
+            from dlrover_tpu.common.config import Context as _Ctx
+
+            self._clock_sync.maybe_probe(
+                _Ctx.singleton().steptrace_probe_interval_s)
         try:
             from dlrover_tpu.agent.monitor import export_chip_stats
 
@@ -1301,6 +1401,8 @@ class ElasticTrainLoop:
     def _flush_telemetry(self) -> None:
         if self.client is not None:
             self._span_exporter.flush_to(self.client)
+            if self._steptrace is not None:
+                self._steptrace.flush_to(self.client)
 
     def close(self) -> None:
         self._flush_telemetry()

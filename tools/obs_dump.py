@@ -5,6 +5,8 @@ Usage:
     python tools/obs_dump.py /tmp/dlrover-tpu-flight/flight-worker-123.json
     python tools/obs_dump.py --spans-only dump.json      # hide raw events
     python tools/obs_dump.py --name rendezvous dump.json # filter by name
+    python tools/obs_dump.py --step 100:120 dump.json    # step-attr window
+    python tools/obs_dump.py --since 60 dump.json        # last 60s only
 
 Output: one line per record, time-ordered relative to the first record —
     +12.304s  SPAN   rendezvous_round                0.512s  ok  round=3
@@ -23,6 +25,25 @@ from datetime import datetime, timezone
 
 def _fmt_attrs(attrs: dict) -> str:
     return " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+
+
+def parse_step_range(spec: str):
+    """``N`` or ``N:M`` (inclusive) → (lo, hi); raises ValueError."""
+    lo, sep, hi = spec.partition(":")
+    start = int(lo)
+    end = int(hi) if sep else start
+    if end < start:
+        raise ValueError(f"empty step range {spec!r}")
+    return start, end
+
+
+def _record_step(record: dict):
+    """The record's step attribute, if it carries an integer-ish one."""
+    value = record.get("attrs", {}).get("step")
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return None
 
 
 def _render_goodput_tail(payload: dict) -> list:
@@ -44,7 +65,8 @@ def _render_goodput_tail(payload: dict) -> list:
 
 
 def render(payload: dict, spans_only: bool = False,
-           name_filter: str = "") -> str:
+           name_filter: str = "", step_range=None,
+           since_s: float = 0.0) -> str:
     events = payload.get("events", [])
     lines = [
         "flight recorder dump: role={role} pid={pid} host={host} "
@@ -58,7 +80,13 @@ def render(payload: dict, spans_only: bool = False,
         "",
     ]
     t0 = events[0].get("ts", 0.0) if events else 0.0
+    # --since is anchored at the dump moment (falling back to the
+    # newest record): "the last N seconds before the dump happened"
+    anchor = payload.get("dumped_at", 0.0) or (
+        events[-1].get("ts", 0.0) if events else 0.0)
     shown = 0
+    filtered = bool(name_filter or spans_only or step_range
+                    or since_s > 0)
     for record in events:
         kind = record.get("kind", "event")
         if spans_only and kind != "span":
@@ -66,6 +94,13 @@ def render(payload: dict, spans_only: bool = False,
         name = str(record.get("name", "?"))
         if name_filter and name_filter not in name:
             continue
+        if since_s > 0 and record.get("ts", 0.0) < anchor - since_s:
+            continue
+        if step_range is not None:
+            step = _record_step(record)
+            if step is None or not (
+                    step_range[0] <= step <= step_range[1]):
+                continue
         shown += 1
         offset = record.get("ts", 0.0) - t0
         record_attrs = record.get("attrs", {})
@@ -91,10 +126,10 @@ def render(payload: dict, spans_only: bool = False,
             lines.append(
                 f"+{offset:9.3f}s  EVENT  {name:<28} "
                 f"{'':10} {attrs}".rstrip())
-    if name_filter or spans_only:
+    if filtered:
         lines.append("")
         lines.append(f"shown: {shown}/{len(events)}")
-    if not (spans_only or name_filter):
+    else:
         lines.extend(_render_goodput_tail(payload))
     return "\n".join(lines)
 
@@ -109,7 +144,22 @@ def main(argv=None) -> int:
                         help="show only span records")
     parser.add_argument("--name", default="",
                         help="substring filter on record names")
+    parser.add_argument("--step", default="",
+                        help="only records whose step attr is N or in "
+                             "N:M (inclusive); records without a step "
+                             "attr are hidden")
+    parser.add_argument("--since", type=float, default=0.0,
+                        metavar="SECS",
+                        help="only records from the last SECS seconds "
+                             "before the dump moment")
     ns = parser.parse_args(argv)
+    step_range = None
+    if ns.step:
+        try:
+            step_range = parse_step_range(ns.step)
+        except ValueError as e:
+            print(f"bad --step {ns.step!r}: {e}", file=sys.stderr)
+            return 2
     status = 0
     for path in ns.paths:
         try:
@@ -121,7 +171,8 @@ def main(argv=None) -> int:
             continue
         if len(ns.paths) > 1:
             print(f"== {path}")
-        print(render(payload, ns.spans_only, ns.name))
+        print(render(payload, ns.spans_only, ns.name,
+                     step_range=step_range, since_s=ns.since))
     return status
 
 

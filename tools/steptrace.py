@@ -1,0 +1,331 @@
+#!/usr/bin/env python3
+"""Per-step critical-path waterfall: who gated each fleet step, on what.
+
+Renders the StepTraceAssembler's payload (master/steptrace.py) — every
+lane is one rank's clock-aligned step timeline, the ``*`` lane is the
+one the solver attributed the step to:
+
+    # live: against a running master
+    python tools/steptrace.py --master 10.0.0.2:50051 --last 16
+
+    # postmortem: the same waterfall from a master flight dump
+    python tools/steptrace.py --flight flight-master-7.json
+
+    # Perfetto / chrome://tracing export (trace-event JSON)
+    python tools/steptrace.py --flight dump.json --chrome-trace out.json
+
+The renderer is a pure function of the payload and the payload is pure
+JSON, so the live render and the flight-dump render of the same window
+are byte-identical (golden-tested).
+
+Exit codes: 0 ok; 2 on unreachable master / unreadable dump / no trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+_DEFAULT_WIDTH = 64
+
+# one letter per phase lane cell; "." = the rank was outside its step
+PHASE_CHARS = {
+    "data_wait": "d",
+    "h2d": "h",
+    "compute": "C",
+    "local_post": "p",
+    "cross_slice_wait": "w",
+    "apply": "a",
+    "host_sync": "s",
+    "checkpoint": "K",
+}
+
+
+def _phase_char(name: str) -> str:
+    return PHASE_CHARS.get(name, "?")
+
+
+def _lane_cells(lane: Dict[str, Any], t0: float, span: float,
+                width: int) -> str:
+    """One rank's timeline row: midpoint-sampled phase letters."""
+    offset = float(lane.get("start", t0)) - t0
+    segs = lane.get("phases") or []
+    cells = []
+    for col in range(width):
+        t = (col + 0.5) / width * span - offset
+        char = "."
+        for seg in segs:
+            try:
+                name, start, dur = str(seg[0]), float(seg[1]), float(seg[2])
+            except (TypeError, ValueError, IndexError):
+                continue
+            if start <= t < start + max(dur, 1e-12):
+                char = _phase_char(name)
+                break
+        cells.append(char)
+    return "".join(cells)
+
+
+def render_step(group: Dict[str, Any],
+                width: int = _DEFAULT_WIDTH) -> List[str]:
+    """One solved group's waterfall block (pure, deterministic)."""
+    t0 = float(group.get("t0", 0.0))
+    span = max(float(group.get("span_s", 0.0)), 1e-9)
+    err = float(group.get("clock_err_max", -1.0))
+    err_text = f"  clock ±{err * 1e3:.3f}ms" if err >= 0.0 else ""
+    wait_frac = float(group.get("cross_slice_wait_fraction", 0.0))
+    wait_text = (f"  cross-slice wait {100.0 * wait_frac:.1f}%"
+                 if wait_frac > 0 else "")
+    hop_text = ", via barrier hop" if group.get("hopped") else ""
+    lines = [
+        "step {:>8} gen {:<4} span {:>9.3f}ms  gating: rank {} "
+        "({} {:.3f}ms{}){}{}".format(
+            group.get("step", "?"), group.get("gen", "?"), span * 1e3,
+            group.get("gating_rank", "?"),
+            group.get("gating_phase") or "?",
+            float(group.get("gating_s", 0.0)) * 1e3,
+            hop_text, wait_text, err_text)]
+    gating_rank = int(group.get("gating_rank", -1))
+    for lane in group.get("lanes") or []:
+        rank = int(lane.get("rank", -1))
+        marker = "*" if rank == gating_rank else " "
+        slice_id = int(lane.get("slice", -1))
+        slice_text = f"s{slice_id}" if slice_id >= 0 else "--"
+        lines.append("  rank {:>4} {:<3} {}|{}|".format(
+            rank, slice_text, marker,
+            _lane_cells(lane, t0, span, width)))
+    return lines
+
+
+def render_waterfall(payload: Dict[str, Any],
+                     width: int = _DEFAULT_WIDTH) -> str:
+    """The whole payload's waterfall + windowed attribution footer."""
+    steps = payload.get("steps") or []
+    lines = [f"steptrace waterfall: {len(steps)} assembled steps"]
+    legend = "  ".join(f"{char}={name}"
+                       for name, char in PHASE_CHARS.items())
+    lines.append(f"legend: {legend}  .=outside step  *=gating lane")
+    lines.append("")
+    for group in steps:
+        if not group:
+            continue
+        lines.extend(render_step(group, width))
+        lines.append("")
+    summary = payload.get("summary") or {}
+    total = int(summary.get("steps", 0))
+    if total > 0:
+        wait = float(summary.get("cross_slice_wait_fraction", -1.0))
+        wait_text = f"{100.0 * wait:.1f}%" if wait >= 0.0 else "-"
+        lines.append(
+            "window: {} steps  dominant rank {}  dominant phase {}  "
+            "cross-slice wait {}".format(
+                total, summary.get("dominant_gating_rank", "?"),
+                summary.get("dominant_gating_phase", "?"), wait_text))
+        for rank, entry in sorted(
+                (summary.get("by_rank") or {}).items(),
+                key=lambda kv: (-int(kv[1].get("gating_steps", 0)),
+                                kv[0])):
+            phases = " ".join(
+                f"{name}={secs:.3f}s" for name, secs in sorted(
+                    (entry.get("phases") or {}).items()))
+            lines.append("  rank {:>4}: gated {}/{} steps  {}".format(
+                rank, entry.get("gating_steps", 0), total, phases))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# chrome trace-event export (Perfetto / chrome://tracing)
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Trace-event JSON: one process per rank, "X" complete events per
+    phase segment (µs, clock-aligned via the stamped offsets), and
+    "s"/"f" flow arrows across the barrier join on hopped steps — the
+    gating slice's post marks the source, the waiting lane's wait end
+    the sink. Durations and timestamps are clamped non-negative."""
+    steps = payload.get("steps") or []
+    bases = [float(g.get("t0", 0.0)) for g in steps if g]
+    origin = min(bases) if bases else 0.0
+    events: List[Dict[str, Any]] = []
+    seen_ranks: Dict[int, int] = {}
+    flow_id = 0
+    for group in steps:
+        if not group:
+            continue
+        step = int(group.get("step", -1))
+        gen = int(group.get("gen", 0))
+        gating_rank = int(group.get("gating_rank", -1))
+        hopped = bool(group.get("hopped", False))
+        post_end_us: Optional[float] = None
+        wait_sinks: List[Dict[str, Any]] = []
+        for lane in group.get("lanes") or []:
+            rank = int(lane.get("rank", -1))
+            if rank not in seen_ranks:
+                seen_ranks[rank] = int(lane.get("slice", -1))
+            base_us = max(
+                0.0, (float(lane.get("start", origin)) - origin) * 1e6)
+            for seg in lane.get("phases") or []:
+                try:
+                    name = str(seg[0])
+                    start_us = float(seg[1]) * 1e6
+                    dur_us = max(0.0, float(seg[2]) * 1e6)
+                except (TypeError, ValueError, IndexError):
+                    continue
+                ts = max(0.0, base_us + start_us)
+                events.append({
+                    "name": name, "cat": "steptrace", "ph": "X",
+                    "ts": round(ts, 3), "dur": round(dur_us, 3),
+                    "pid": rank, "tid": 0,
+                    "args": {"step": step, "gen": gen},
+                })
+                if (hopped and rank == gating_rank
+                        and name == "local_post"):
+                    post_end_us = ts + dur_us
+                if name == "cross_slice_wait" and rank != gating_rank:
+                    wait_sinks.append({"rank": rank,
+                                       "ts": ts + dur_us})
+        if hopped and post_end_us is not None:
+            for sink in wait_sinks:
+                flow_id += 1
+                common = {"name": "grad_header", "cat": "cross_slice",
+                          "id": flow_id,
+                          "args": {"step": step, "gen": gen}}
+                events.append(dict(
+                    common, ph="s", pid=gating_rank, tid=0,
+                    ts=round(post_end_us, 3)))
+                # bind to the enclosing slice's end: the arrow lands
+                # where the wait resolved, never before it began
+                events.append(dict(
+                    common, ph="f", bp="e", pid=sink["rank"], tid=0,
+                    ts=round(max(sink["ts"], post_end_us), 3)))
+    metadata = [
+        {"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+         "args": {"name": f"rank {rank}"
+                  + (f" (slice {sid})" if sid >= 0 else "")}}
+        for rank, sid in sorted(seen_ranks.items())]
+    return {"traceEvents": metadata + events,
+            "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+
+def payload_from_flight(dump: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The newest ``steptrace`` snapshot event in a flight dump (the
+    master embeds its assembler's query payload at stop time)."""
+    snapshot = None
+    for record in dump.get("events", []):
+        if (record.get("kind") == "event"
+                and record.get("name") == "steptrace"
+                and isinstance(record.get("attrs", {}).get("snapshot"),
+                               dict)):
+            snapshot = record["attrs"]["snapshot"]
+    return snapshot
+
+
+def _parse_step_range(spec: str):
+    lo, sep, hi = spec.partition(":")
+    start = int(lo)
+    end = int(hi) if sep else start
+    if end < start:
+        raise ValueError(f"empty step range {spec!r}")
+    return start, end
+
+
+def _filter_payload(payload: Dict[str, Any], step_range) -> Dict[str, Any]:
+    if step_range is None:
+        return payload
+    lo, hi = step_range
+    return {
+        "version": payload.get("version", 1),
+        "steps": [g for g in payload.get("steps") or []
+                  if g and lo <= int(g.get("step", -1)) <= hi],
+        "summary": payload.get("summary") or {},
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "steptrace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--master", default="",
+                        help="live master address (host:port)")
+    parser.add_argument("--flight", default="",
+                        help="master flight-recorder dump file")
+    parser.add_argument("--last", type=int, default=32,
+                        help="newest N assembled steps (live source)")
+    parser.add_argument("--step", default="",
+                        help="only steps N or N:M (inclusive)")
+    parser.add_argument("--width", type=int, default=_DEFAULT_WIDTH,
+                        help="waterfall lane width in characters")
+    parser.add_argument("--chrome-trace", default="", metavar="OUT",
+                        help="write Perfetto/chrome trace-event JSON "
+                             "to OUT instead of rendering the "
+                             "waterfall")
+    ns = parser.parse_args(argv)
+    if bool(ns.master) == bool(ns.flight):
+        parser.error("exactly one of --master / --flight is required")
+    step_range = None
+    if ns.step:
+        try:
+            step_range = _parse_step_range(ns.step)
+        except ValueError as e:
+            print(f"bad --step {ns.step!r}: {e}", file=sys.stderr)
+            return 2
+
+    if ns.flight:
+        try:
+            with open(ns.flight) as f:
+                dump = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{ns.flight}: unreadable dump: {e}", file=sys.stderr)
+            return 2
+        payload = payload_from_flight(dump)
+        if payload is None:
+            print(f"{ns.flight}: no steptrace snapshot in dump",
+                  file=sys.stderr)
+            return 2
+    else:
+        try:
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            client = MasterClient(ns.master, node_id=-1)
+            try:
+                kwargs = {"last_n": ns.last}
+                if step_range is not None:
+                    kwargs = {"start_step": step_range[0],
+                              "end_step": step_range[1]}
+                payload = client.query_steptrace(**kwargs)
+            finally:
+                client.close()
+        except Exception as e:  # noqa: BLE001 — transport setup varies
+            print(f"master {ns.master}: {e}", file=sys.stderr)
+            return 2
+        if not payload:
+            print(f"master {ns.master}: no steptrace payload "
+                  "(older master?)", file=sys.stderr)
+            return 2
+
+    payload = _filter_payload(payload, step_range)
+    if ns.chrome_trace:
+        trace = chrome_trace(payload)
+        with open(ns.chrome_trace, "w") as f:
+            json.dump(trace, f, indent=1)
+        print(f"wrote {len(trace['traceEvents'])} trace events to "
+              f"{ns.chrome_trace}")
+        return 0
+    print(render_waterfall(payload, width=max(8, ns.width)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

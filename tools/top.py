@@ -17,9 +17,10 @@ Sections: job vitals with sparklines (steps/s, MFU, goodput fraction),
 per-slice step-time/MFU/goodput rollups, per-rank HBM watermark bars
 (device-truth in-step peaks, obs/device.py), the planner calibration
 table (predicted vs measured step time per mesh — parallel/
-calibration.py), control-plane health (slices formed / generations),
-recent diagnosis reports and the resize/promotion history priced by the
-goodput ledger.
+calibration.py), the steptrace critical-path panel (who gated the
+traced steps, on what phase — master/steptrace.py), control-plane
+health (slices formed / generations), recent diagnosis reports and the
+resize/promotion history priced by the goodput ledger.
 
 Exit codes: 0 ok; 2 on unreadable inputs / unreachable master.
 """
@@ -146,6 +147,10 @@ def collect_from_master(client, window_s: float = 900.0
         calibration = client.get_plan_calibration()
     except Exception:  # noqa: BLE001
         calibration = {}
+    try:
+        steptrace = client.query_steptrace(last_n=64)
+    except Exception:  # noqa: BLE001 — older master / no assembler
+        steptrace = {}
     return {
         "source": f"master {client.master_addr}",
         "series": series,
@@ -155,6 +160,7 @@ def collect_from_master(client, window_s: float = 900.0
         "slices": slices,
         "diagnosis": diagnosis,
         "calibration": calibration,
+        "steptrace": steptrace,
         "history": [],
     }
 
@@ -170,6 +176,7 @@ def collect_from_flight(payload: Dict[str, Any],
     series: List[Dict[str, Any]] = []
     stats: Dict[str, Any] = {}
     calibration: Dict[str, Any] = {}
+    steptrace: Dict[str, Any] = {}
     diagnosis: List[Dict[str, Any]] = []
     history: List[Dict[str, Any]] = []
     for record in payload.get("events", []):
@@ -188,6 +195,8 @@ def collect_from_flight(payload: Dict[str, Any],
                 # screen does ({} on dumps predating the field)
                 "discounts": attrs.get("axis_discounts") or {},
             }
+        elif name == "steptrace":
+            steptrace = attrs.get("snapshot") or {}
         elif name == "diagnosis":
             diagnosis.append({
                 "rule": attrs.get("rule", "?"),
@@ -210,6 +219,7 @@ def collect_from_flight(payload: Dict[str, Any],
         "slices": {},
         "diagnosis": diagnosis[-8:],
         "calibration": calibration,
+        "steptrace": steptrace,
         "history": history,
     }
 
@@ -350,6 +360,42 @@ def render_calibration(data: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def render_critical_path(data: Dict[str, Any]) -> List[str]:
+    """Steptrace attribution: WHO gated the traced steps and on WHAT
+    (master/steptrace.py query payload / flight snapshot)."""
+    steptrace = data.get("steptrace") or {}
+    summary = steptrace.get("summary") or {}
+    steps = int(summary.get("steps", 0))
+    lines = ["== critical path (steptrace attribution)"]
+    if steps <= 0:
+        lines.append("  (no traced steps)")
+        return lines
+    wait = float(summary.get("cross_slice_wait_fraction", -1.0))
+    wait_text = f"{100.0 * wait:.1f}%" if wait >= 0.0 else "-"
+    lines.append(
+        "  {} traced steps   dominant rank {}   dominant phase {}   "
+        "cross-slice wait {}".format(
+            steps, summary.get("dominant_gating_rank", "?"),
+            summary.get("dominant_gating_phase", "?"), wait_text))
+    by_rank = summary.get("by_rank") or {}
+    ranked = sorted(
+        by_rank.items(),
+        key=lambda kv: (-float(kv[1].get("gating_s", 0.0)), kv[0]))
+    if ranked:
+        lines.append("  {:<6} {:>12} {:>10} {:<16} {}".format(
+            "rank", "gated", "seconds", "phase", "share"))
+    for rank_key, entry in ranked[:8]:
+        gating_steps = int(entry.get("gating_steps", 0))
+        phases = entry.get("phases") or {}
+        phase = max(sorted(phases), key=lambda p: phases[p],
+                    default="?")
+        lines.append("  {:<6} {:>12} {:>10} {:<16} {}".format(
+            rank_key, f"{gating_steps}/{steps}",
+            f"{float(entry.get('gating_s', 0.0)):.2f}s", phase,
+            hbar(gating_steps / steps, 12)))
+    return lines
+
+
 def render_diagnosis(data: Dict[str, Any]) -> List[str]:
     reports = data.get("diagnosis") or []
     lines = [f"== recent diagnosis ({len(reports)})"]
@@ -422,6 +468,7 @@ def render(data: Dict[str, Any]) -> str:
         render_slices_section(data),
         render_hbm(data),
         render_calibration(data),
+        render_critical_path(data),
         render_diagnosis(data),
         render_history(data),
         render_store(data),
