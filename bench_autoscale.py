@@ -1,0 +1,234 @@
+"""Fleet-controller benchmark: goodput with the controller riding a
+scripted preemptible-capacity market vs the same schedule uncontrolled.
+
+The story being measured (ISSUE 18): the master's fleet controller
+(brain/fleet_controller.py) closes the diagnosis→actuation loop — it
+claims an offered preemptible slice when the predicted marginal goodput
+beats the join+re-plan cost, books a market revocation through the
+PR 5 drain path, and prices every move in the goodput ledger under the
+``autoscale`` elasticity kind.
+
+Both legs run the SAME wall-clock schedule against a real in-process
+JobMaster (warm → capacity offer → grown window with one 3×-slow
+straggler rank → revocation + clean drain → tail):
+
+- ``controller_on``  — the controller claims the offer (hysteresis,
+                       economics and guardrails all live), the granted
+                       rank joins and reports, the revoke drains it;
+- ``controller_off`` — the identical market events happen but nothing
+                       claims, so the offered capacity never produces.
+
+Prints ONE JSON line:
+    {"metric": "autoscale_goodput_gain", "value": R, ...,
+     "controller_on": {...}, "controller_off": {...}}
+
+where ``value`` is productive rank-seconds (from the master's own
+ledger) controller-on over controller-off; > 1.0 means riding the offer
+paid for the claim. ``--smoke`` shrinks the schedule for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def _ensure_cpu_devices(n: int) -> None:
+    """Before jax imports: virtual CPU devices (no-op on accelerators)."""
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and \
+            "JAX_PLATFORMS" not in os.environ:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def _wait_world(client, size: int, timeout_s: float = 10.0) -> dict:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        _, _, world = client.get_comm_world()
+        if world and len(world) >= size:
+            return world
+        time.sleep(0.02)
+    raise TimeoutError(f"world of {size} never formed")
+
+
+def run_leg(controller_on: bool, warm: int, grown: int, tail: int,
+            tick_s: float) -> dict:
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.config import Context
+    from dlrover_tpu.master.job_master import JobMaster
+
+    ctx = Context.singleton()
+    saved = ctx.fleet_controller_enabled
+    ctx.fleet_controller_enabled = controller_on
+    master = JobMaster(port=0, min_nodes=1, max_nodes=2,
+                       host="127.0.0.1")
+    master.prepare()
+    leg_started = time.time()
+    # NOTE: no slice_id — slice-scoped rendezvous routes joins to the
+    # per-slice cut path and a fleet round of [0, 1] would never cut;
+    # the bench measures fleet growth, so the clients stay sliceless.
+    c0 = MasterClient(master.addr, node_id=0, node_rank=0)
+    c1 = None
+    step = 0
+    decisions: list = []
+    straggler_scores: dict = {}
+    world_peak = 1
+    try:
+        c0.join_rendezvous(local_world_size=1)
+        _wait_world(c0, 1)
+
+        def tick(clients):
+            nonlocal step
+            step += 1
+            for client, slow in clients:
+                client.report_global_step(
+                    step, step_time_s=tick_s * (3.0 if slow else 1.0),
+                    data_wait_fraction=0.05)
+            time.sleep(tick_s)
+
+        # phase 1: warm — the ledger accrues the measured goodput the
+        # claim economics need (claiming blind is refused by design)
+        for _ in range(warm):
+            tick([(c0, False)])
+
+        # phase 2: the market offers one preemptible slice
+        if controller_on:
+            provider = master.capacity_provider
+
+            def grant(offer):
+                nonlocal c1, world_peak
+                c1 = MasterClient(master.addr, node_id=1, node_rank=1)
+                try:
+                    c1.join_rendezvous(local_world_size=1)
+                    c0.join_rendezvous(local_world_size=1)
+                    _wait_world(c0, 2)
+                except Exception:
+                    # a grant that never formed the world must not leave
+                    # a half-joined rank reporting into the ledger
+                    c1.close()
+                    c1 = None
+                    raise
+                world_peak = 2
+                return [1]
+
+            provider.grant_fn = grant
+            provider.offer(slices=1, ttl_s=600.0, step=step)
+            # two rounds: hysteresis demands consecutive windows of the
+            # same candidate before the claim actuates
+            for _ in range(
+                    ctx.autoscale_hysteresis_windows + 1):
+                record = master.fleet_controller.evaluate_once()
+                if record is not None:
+                    decisions.append({"kind": record["kind"],
+                                      "reason": record["reason"]})
+                if c1 is not None:
+                    break
+
+        # phase 3: the grown window — the claimed rank produces, but as
+        # a 3×-slow straggler (the dispatch-weighting evidence)
+        for _ in range(grown):
+            members = [(c0, False)]
+            if c1 is not None:
+                members.append((c1, True))
+            tick(members)
+        straggler_scores = {
+            str(rank): round(score, 3)
+            for rank, score in
+            master.speed_monitor.relative_speeds().items()}
+
+        # phase 4: the market takes the slice back; the revoke books
+        # through the provider and the slice drains cleanly (PR 5 path)
+        if controller_on and c1 is not None:
+            master.capacity_provider.revoke(1, grace_s=2.0, step=step)
+            c1.report_drain(deadline=time.time() + 2.0,
+                            reason="capacity revoked", phase="notice")
+            time.sleep(0.05)
+            c1.report_drain(deadline=0, phase="complete")
+            c1.close()
+            c1 = None
+            c0.join_rendezvous(local_world_size=1)
+            _wait_world(c0, 1)
+
+        # phase 5: tail — back to owned capacity only
+        for _ in range(tail):
+            tick([(c0, False)])
+
+        snap = master.goodput_ledger.snapshot()
+        productive = sum(float(inc.get("productive", 0.0))
+                         for inc in snap.get("incarnations", []))
+        window = master.goodput_ledger.window_summary(3600.0)
+        status = (master.fleet_controller.status()
+                  if master.fleet_controller is not None else {})
+        elapsed = max(1e-9, time.time() - leg_started)
+        return {
+            "productive_rank_seconds": round(productive, 3),
+            # productive rank-seconds per wall second of the leg — the
+            # windowed goodput both legs are compared on (same wall
+            # schedule, so the rate is the fair cross-leg measure; the
+            # ledger's own goodput_fraction divides by PRESENT
+            # rank-seconds and penalizes the on-leg for having ridden
+            # a second, join-cost-paying slice at all)
+            "goodput_rate": round(productive / elapsed, 4),
+            "leg_elapsed_s": round(elapsed, 3),
+            "goodput_fraction": round(
+                float(window.get("goodput_fraction", -1.0)), 4),
+            "world_peak": world_peak,
+            "final_step": step,
+            "decisions": decisions,
+            "decision_history": [
+                {"kind": d.get("kind"), "outcome": d.get("outcome"),
+                 "reason": d.get("reason")}
+                for d in status.get("decisions", [])],
+            "incarnation_reasons": [
+                inc.get("reason")
+                for inc in snap.get("incarnations", [])],
+            "straggler_scores": straggler_scores,
+        }
+    finally:
+        if c1 is not None:
+            c1.close()
+        c0.close()
+        master.stop(grace_s=0.1)
+        ctx.fleet_controller_enabled = saved
+
+
+def run_bench(smoke: bool) -> dict:
+    warm, grown, tail, tick_s = ((6, 8, 3, 0.03) if smoke
+                                 else (12, 24, 6, 0.05))
+    on = run_leg(True, warm, grown, tail, tick_s)
+    off = run_leg(False, warm, grown, tail, tick_s)
+    base = off["productive_rank_seconds"]
+    gain = (on["productive_rank_seconds"] / base) if base > 0 else 0.0
+    return {
+        "metric": "autoscale_goodput_gain",
+        "value": round(gain, 3),
+        "unit": ("productive rank-seconds, controller-on / "
+                 "controller-off, same scripted offer/revoke/"
+                 "straggler schedule"),
+        "schedule": {"warm": warm, "grown": grown, "tail": tail,
+                     "tick_s": tick_s, "smoke": smoke},
+        "controller_on": on,
+        "controller_off": off,
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("bench_autoscale",
+                                     description=__doc__)
+    parser.add_argument("--smoke", action="store_true",
+                        help="shrunk schedule for CI (same code paths)")
+    ns = parser.parse_args()
+    result = run_bench(ns.smoke)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    _ensure_cpu_devices(2)
+    raise SystemExit(main())

@@ -741,6 +741,15 @@ class MasterClient:
             msg.StepTraceResult)
         return self._json_dict(result.result_json)
 
+    def get_autoscale_status(self) -> dict:
+        """The fleet controller's decision history + guardrail state
+        (brain/fleet_controller.py): {"decisions", "watch",
+        "quarantine", "offers", ...}. {} = controller disabled or
+        master predates it."""
+        result = self._get_typed(msg.AutoscaleStatusRequest(),
+                                 msg.AutoscaleStatus)
+        return self._json_dict(result.status_json)
+
     def probe_clock(self) -> float:
         """One NTP-style clock probe: the master's wall clock, or -1.0
         on failure / a master that predates ClockProbe. Deliberately a

@@ -220,6 +220,55 @@ class Context:
         self.quarantine_window_s: float = (
             DefaultValues.QUARANTINE_WINDOW_S
         )
+        # goodput-optimal fleet controller (brain/fleet_controller.py):
+        # claim/shed/hold decisions from the measured ledger, guarded by
+        # hysteresis + cooldown + rate limit + the rollback watchdog
+        self.fleet_controller_enabled: bool = (
+            DefaultValues.FLEET_CONTROLLER_ENABLED
+        )
+        self.autoscale_interval_s: float = (
+            DefaultValues.AUTOSCALE_INTERVAL_S
+        )
+        self.autoscale_cooldown_s: float = (
+            DefaultValues.AUTOSCALE_COOLDOWN_S
+        )
+        self.autoscale_hysteresis_windows: int = (
+            DefaultValues.AUTOSCALE_HYSTERESIS_WINDOWS
+        )
+        self.autoscale_max_decisions_per_hour: int = (
+            DefaultValues.AUTOSCALE_MAX_DECISIONS_PER_HOUR
+        )
+        self.autoscale_rollback_drop_fraction: float = (
+            DefaultValues.AUTOSCALE_ROLLBACK_DROP_FRACTION
+        )
+        self.autoscale_rollback_window_s: float = (
+            DefaultValues.AUTOSCALE_ROLLBACK_WINDOW_S
+        )
+        self.autoscale_quarantine_backoff_s: float = (
+            DefaultValues.AUTOSCALE_QUARANTINE_BACKOFF_S
+        )
+        self.autoscale_claim_margin: float = (
+            DefaultValues.AUTOSCALE_CLAIM_MARGIN
+        )
+        self.autoscale_shed_wait_fraction: float = (
+            DefaultValues.AUTOSCALE_SHED_WAIT_FRACTION
+        )
+        # speed-aware dynamic sharding (master/shard/task_manager.py):
+        # False = byte-identical legacy round-robin dispatch
+        self.dispatch_speed_weighted: bool = (
+            DefaultValues.DISPATCH_SPEED_WEIGHTED
+        )
+        self.dispatch_weight_floor: float = (
+            DefaultValues.DISPATCH_WEIGHT_FLOOR
+        )
+        # data-pipeline auto-tune (data/prefetch.py): advisory depth /
+        # ring sizing from the timeline's data_wait fraction
+        self.prefetch_autotune: bool = DefaultValues.PREFETCH_AUTOTUNE
+        self.prefetch_depth_min: int = DefaultValues.PREFETCH_DEPTH_MIN
+        self.prefetch_depth_max: int = DefaultValues.PREFETCH_DEPTH_MAX
+        self.data_wait_tune_fraction: float = (
+            DefaultValues.DATA_WAIT_TUNE_FRACTION
+        )
         self.relaunch_on_worker_failure: bool = True
         self.auto_scale_enabled: bool = False
         self.network_check_enabled: bool = False

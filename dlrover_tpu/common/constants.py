@@ -445,6 +445,55 @@ class DefaultValues:
     # one hard-coded 4096)
     FLIGHT_RING_EVENTS = 4096
     FLIGHT_RING_SPANS = 4096
+    # -- goodput-optimal fleet controller (brain/fleet_controller.py) ---
+    # master-side control loop that claims offered preemptible slices,
+    # sheds a gating slice, or holds — every actuation through the
+    # existing drain/rejoin machinery. Off by default: the controller
+    # changes fleet membership on its own authority; jobs opt in.
+    FLEET_CONTROLLER_ENABLED = False
+    # evaluation cadence of the control loop
+    AUTOSCALE_INTERVAL_S = 30.0
+    # after any actuation, no new decision for this long (lets the
+    # rollback watchdog's observation window conclude first)
+    AUTOSCALE_COOLDOWN_S = 120.0
+    # hysteresis: consecutive evaluations agreeing on the same decision
+    # before it actuates (one noisy window must not resize the fleet)
+    AUTOSCALE_HYSTERESIS_WINDOWS = 2
+    # hard ceiling on actuations per hour, claims and sheds combined
+    # (rollbacks are exempt — undoing damage must never be rate-limited)
+    AUTOSCALE_MAX_DECISIONS_PER_HOUR = 6
+    # rollback watchdog: windowed goodput fraction dropping by more than
+    # this (absolute) versus the pre-actuation window reverts the
+    # decision and quarantines its class
+    AUTOSCALE_ROLLBACK_DROP_FRACTION = 0.2
+    # how long after an actuation the watchdog compares windows
+    AUTOSCALE_ROLLBACK_WINDOW_S = 120.0
+    # quarantine base for a rolled-back decision class; doubles per
+    # consecutive rollback of the same class, capped at 8x
+    AUTOSCALE_QUARANTINE_BACKOFF_S = 600.0
+    # claim economics: predicted marginal goodput (rank-seconds over the
+    # offer's expected lifetime) must exceed the join+re-plan cost
+    # estimate by this ratio before a claim fires
+    AUTOSCALE_CLAIM_MARGIN = 1.2
+    # shed trigger: steptrace must name the slice gating AND the fleet's
+    # cross-slice wait fraction must exceed this
+    AUTOSCALE_SHED_WAIT_FRACTION = 0.3
+    # -- speed-aware dynamic sharding (master/shard/) -------------------
+    # weight get_task dispatch by observed per-rank speed so faster
+    # workers pull more shards; False = byte-identical legacy dispatch
+    DISPATCH_SPEED_WEIGHTED = False
+    # the slowest rank is still served at least one shard per this many
+    # fleet dispatches (throttle, never starvation)
+    DISPATCH_WEIGHT_FLOOR = 0.25
+    # -- data-pipeline auto-tune (data/prefetch.py) ---------------------
+    # grow device-prefetch depth / shm-ring capacity while the
+    # timeline's data_wait fraction stays above the trigger; shrink back
+    # when the pipeline stops starving. Advisory values consumed at
+    # (re)build boundaries — never mid-step.
+    PREFETCH_AUTOTUNE = True
+    PREFETCH_DEPTH_MIN = 1
+    PREFETCH_DEPTH_MAX = 8
+    DATA_WAIT_TUNE_FRACTION = 0.2
     # -- per-rank relaunch backoff + quarantine (agent) -----------------
     # exponential delay between worker relaunches: base * 2^(k-1) for the
     # k-th recent failure, capped — a flapping worker must not hot-loop

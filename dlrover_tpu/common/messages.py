@@ -824,6 +824,24 @@ class StepTraceResult(Message):
     result_json: str = ""
 
 
+@dataclass
+class AutoscaleStatusRequest(Message):
+    """tools/diagnose.py (or top.py) asking a live master for the fleet
+    controller's decision history + guardrail state
+    (brain/fleet_controller.py FleetController.status())."""
+
+    pass
+
+
+@dataclass
+class AutoscaleStatus(Message):
+    """JSON FleetController.status() dict ({"decisions", "watch",
+    "quarantine", "offers", ...}). "" = controller disabled
+    (fleet_controller_enabled off) or master predates it."""
+
+    status_json: str = ""
+
+
 # --------------------------------------------------------------------------
 # Brain service (reference: dlrover/proto/brain.proto persist_metrics /
 # optimize / get_job_metrics; dlrover/python/brain/client.py)

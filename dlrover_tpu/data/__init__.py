@@ -9,7 +9,8 @@ Capability parity: atorch/data/ —
 - `ElasticDataLoader` lives in dlrover_tpu/trainer/dataloader.py
 """
 
-from dlrover_tpu.data.prefetch import prefetch_to_device
+from dlrover_tpu.data.prefetch import PrefetchAutoTuner, prefetch_to_device
 from dlrover_tpu.data.shm_ring import ShmDataContext, ShmRing
 
-__all__ = ["prefetch_to_device", "ShmDataContext", "ShmRing"]
+__all__ = ["PrefetchAutoTuner", "prefetch_to_device", "ShmDataContext",
+           "ShmRing"]

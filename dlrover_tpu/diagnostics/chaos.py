@@ -89,6 +89,26 @@ Spec grammar (semicolon-separated faults):
                            step 10 (requires NUM_SLICES in the env;
                            resize:slice:+k writes the request file with
                            unit="slice")
+    offer:slice:+1@10:300  preemptible-market event: the MASTER-side
+                           injector hands the local CapacityProvider
+                           (brain/fleet_controller.py) an offer of 1
+                           spot slice with an expected lifetime of
+                           300 s when any worker reports step 10. TTL
+                           omitted → the provider's default expected
+                           lifetime. The fleet controller then decides
+                           whether claiming it beats the join+re-plan
+                           cost — the offer alone changes nothing.
+    revoke:slice:1@10:20   the spot market takes slice 1 back at step
+                           10 with a 20 s grace window. Fires on BOTH
+                           sides: every member of slice 1 receives the
+                           advance preemption notice (the same file +
+                           drain chain as preempt:slice — the PR 5
+                           path, unchanged), and the master-side
+                           injector tells the CapacityProvider the
+                           capacity is gone so the controller prices
+                           the revocation instead of diagnosing a
+                           surprise. Grace omitted →
+                           Context.preempt_default_grace_s.
 
 Each kill/hang/preempt/resize fault fires at most once per process;
 slow applies from its step onward. Resize faults additionally record a
@@ -130,15 +150,19 @@ CHAOS_STATE_ENV = "DLROVER_TPU_CHAOS_STATE"
 @dataclasses.dataclass
 class ChaosFault:
     action: str            # "kill" | "hang" | "slow" | "preempt" |
-    #                        "resize"
+    #                        "resize" | "offer" | "revoke"
     role: str              # node type the fault targets ("worker",
     #                        "master", …); the resize UNIT ("worker" |
-    #                        "slice") for resize faults
+    #                        "slice") for resize faults; "slice" for
+    #                        the market faults (offer/revoke)
     rank: int              # node rank within the role; the SIGNED
-    #                        delta for resize faults
+    #                        delta for resize faults; the offered
+    #                        slice COUNT for offer; the revoked slice
+    #                        id for revoke
     at_step: int           # fire when the target reaches this step
-    # hang: block seconds; slow: sleep/step; preempt: grace window
-    # (<= 0 → Context.preempt_default_grace_s)
+    # hang: block seconds; slow: sleep/step; preempt/revoke: grace
+    # window (<= 0 → Context.preempt_default_grace_s); offer: expected
+    # lifetime TTL (<= 0 → the provider's default)
     duration: float = 60.0
     fired: bool = False
     # position in the FULL spec (before role/rank filtering): the
@@ -190,11 +214,26 @@ def parse_chaos(spec: str) -> List[ChaosFault]:
                 f"bad chaos fault {part!r} (want "
                 f"'action:role:rank@step[:duration]' or "
                 f"'resize:[slice:]±k@step'): {e}") from e
-        if fault.action not in ("kill", "hang", "slow", "preempt"):
+        if fault.action not in ("kill", "hang", "slow", "preempt",
+                                "offer", "revoke"):
             raise ValueError(f"unknown chaos action {fault.action!r}")
-        if fault.action == "preempt" and len(at_fields) == 1:
+        if fault.action in ("preempt", "revoke") and len(at_fields) == 1:
             fault.duration = 0.0   # grace resolves from Context at fire
-        if fault.rank < 0:
+        if fault.action == "offer":
+            # offer:slice:+k@step[:ttl] — the rank field is the offered
+            # slice COUNT (the grammar writes it signed, like resize)
+            if fault.role != "slice":
+                raise ValueError(
+                    f"offer targets slices, got role {fault.role!r}")
+            if fault.rank <= 0:
+                raise ValueError(
+                    f"offer count must be positive, got {fault.rank}")
+            if len(at_fields) == 1:
+                fault.duration = 0.0   # TTL → the provider's default
+        elif fault.action == "revoke" and fault.role != "slice":
+            raise ValueError(
+                f"revoke targets slices, got role {fault.role!r}")
+        elif fault.rank < 0:
             raise ValueError(
                 f"chaos fault {part!r} has negative rank {fault.rank} "
                 f"(no node can match it)")
@@ -225,20 +264,35 @@ class ChaosInjector:
         # (JobMaster wires them to the sharded rendezvous router)
         self.shard_kill_fn = None
         self.shard_wedge_fn = None
+        # preemptible-market faults (offer/revoke): handled by the
+        # MASTER-side injector through these hooks (JobMaster wires
+        # them to the fleet controller's local CapacityProvider);
+        # offer_fn(count, ttl_s, step), revoke_fn(slice_id, grace_s,
+        # step)
+        self.offer_fn = None
+        self.revoke_fn = None
         # a "slice"-role fault addresses the SLICE in its rank field:
-        # every member of that slice arms it, so kill/preempt fan
-        # across the whole failure domain. Resize faults arm on EVERY
-        # worker — whether this rank is part of the delta is decided at
-        # fire time against the live world/slice count. "shard"-role
-        # faults arm on the MASTER (the shard lives in its process).
+        # every member of that slice arms it, so kill/preempt/revoke
+        # fan across the whole failure domain. Resize faults arm on
+        # EVERY worker — whether this rank is part of the delta is
+        # decided at fire time against the live world/slice count.
+        # "shard"-role faults arm on the MASTER (the shard lives in its
+        # process), and so do the market faults (offer on the master
+        # ONLY; revoke on the master AND on the revoked slice's
+        # members, which reuse the preemption-notice path verbatim).
         self.faults = [
             f for f in parse_chaos(spec)
             if (f.action == "resize" and role == "worker")
-            or (f.role == role and f.rank == rank)
-            or (f.role == "slice" and f.action != "resize"
-                and role == "worker"
-                and slice_id >= 0 and f.rank == slice_id)
-            or (f.role == "shard" and role == "master")
+            or (f.action == "offer" and role == "master")
+            or (f.action == "revoke"
+                and (role == "master"
+                     or (role == "worker" and slice_id >= 0
+                         and f.rank == slice_id)))
+            or (f.action not in ("resize", "offer", "revoke")
+                and ((f.role == role and f.rank == rank)
+                     or (f.role == "slice" and role == "worker"
+                         and slice_id >= 0 and f.rank == slice_id)
+                     or (f.role == "shard" and role == "master")))
         ] if spec else []
         for fault in self.faults:
             if self._already_fired(fault):
@@ -253,10 +307,16 @@ class ChaosInjector:
         # slice-role or resize fault additionally keys on THIS node's
         # rank — every affected member must fire its own copy (one
         # shared marker would let the first member claim the whole
-        # unit's fault and leave the rest alive).
-        per_node = (f"_n{self._rank}"
-                    if fault.role == "slice" or fault.action == "resize"
-                    else "")
+        # unit's fault and leave the rest alive). The master's copy of
+        # a market fault gets its own suffix: worker rank 0 may share
+        # the state dir, and its _n0 marker must not consume the
+        # master-side provider notification (or vice versa).
+        if self._role == "master" and fault.action in ("offer", "revoke"):
+            per_node = "_market"
+        elif fault.role == "slice" or fault.action == "resize":
+            per_node = f"_n{self._rank}"
+        else:
+            per_node = ""
         return os.path.join(
             self._state_dir,
             f"chaos_{fault.index}_{fault.action}_{fault.role}"
@@ -346,6 +406,16 @@ class ChaosInjector:
                 continue
             if fault.role == "shard":
                 self._inject_shard_fault(fault, step)
+            elif (fault.action in ("offer", "revoke")
+                    and self._role == "master"):
+                self._inject_market(fault, step)
+            elif fault.action == "revoke":
+                # worker side: the revoked slice's members receive the
+                # standard advance preemption notice — the established
+                # drain chain, unchanged
+                if not self._record_fired(fault):
+                    continue
+                self._write_preemption_notice(fault, step)
             elif fault.action == "kill":
                 # record BEFORE dying, or the respawned incarnation
                 # replays the fault forever
@@ -409,6 +479,37 @@ class ChaosInjector:
             logger.warning("chaos: unsupported shard fault %s ignored",
                            fault.action)
             fault.fired = True
+
+    def _inject_market(self, fault: ChaosFault, step: int) -> None:
+        """Master-side preemptible-market events, delivered to the
+        fleet controller's local CapacityProvider through the wired
+        hooks (no-op with a warning when no controller is running)."""
+        if not self._record_fired(fault):
+            return
+        if fault.action == "offer":
+            if self.offer_fn is None:
+                logger.warning(
+                    "chaos offer:slice:+%d armed but no capacity "
+                    "provider wired (fleet_controller_enabled off?)",
+                    fault.rank)
+                return
+            logger.warning(
+                "chaos: market offers %d slice(s) at step %d "
+                "(ttl %.1fs)", fault.rank, step, fault.duration)
+            self.offer_fn(fault.rank, fault.duration, step)
+            return
+        from dlrover_tpu.common.config import Context
+
+        grace = (fault.duration if fault.duration > 0
+                 else Context.singleton().preempt_default_grace_s)
+        if self.revoke_fn is None:
+            logger.warning(
+                "chaos revoke:slice:%d armed but no capacity provider "
+                "wired (fleet_controller_enabled off?)", fault.rank)
+            return
+        logger.warning("chaos: market revokes slice %d at step %d "
+                       "(grace %.1fs)", fault.rank, step, grace)
+        self.revoke_fn(fault.rank, grace, step)
 
     def _inject_resize(self, fault: ChaosFault, step: int) -> None:
         """Deterministic mid-run resize. Scale-DOWN (delta < 0): this
@@ -499,7 +600,7 @@ class ChaosInjector:
             return
         payload = {"deadline": time.time() + grace,
                    "grace_s": grace,
-                   "reason": f"chaos preempt@{fault.at_step}"}
+                   "reason": f"chaos {fault.action}@{fault.at_step}"}
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f)

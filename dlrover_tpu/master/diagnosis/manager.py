@@ -230,6 +230,22 @@ class DiagnosisManager:
         with self._diag_lock:
             self._emit(report, Context.singleton())
 
+    def observe_autoscale(self, kind: str, reason: str,
+                          evidence: Optional[Dict[str, Any]] = None,
+                          severity: str = "info") -> None:
+        """A fleet-controller decision (brain/fleet_controller.py):
+        claim / shed / hold / rollback lands in the report history so
+        postmortems read WHY the fleet changed shape next to the
+        straggler and goodput evidence that drove it."""
+        report = DiagnosisReport(
+            rule="autoscale", severity=severity, worker_id=-1,
+            summary=f"autoscale {kind}: {reason}",
+            details=dict(evidence or {}, kind=kind),
+            ts=time.time(),
+        )
+        with self._diag_lock:
+            self._emit(report, Context.singleton())
+
     def request_checkpoint(self, ranks, deadline: float,
                            reason: str = "") -> List[int]:
         """Urgent ``checkpoint`` fan-out (a peer is draining): enqueue a

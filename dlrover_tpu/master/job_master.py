@@ -115,6 +115,29 @@ class JobMaster:
                 goodput_ledger=self.goodput_ledger,
                 plan_calibration=self.plan_calibration,
                 steptrace=self.steptrace)
+        # the goodput-optimal fleet controller
+        # (brain/fleet_controller.py): closes the diagnosis→actuation
+        # loop — claims offered preemptible slices, sheds gating ones,
+        # holds behind guardrails. Deliberately gated on its OWN knob,
+        # not the legacy auto_scale_enabled (node-count autoscaling,
+        # JobAutoScaler): the two act on different layers.
+        self.capacity_provider = None
+        self.fleet_controller = None
+        if ctx.fleet_controller_enabled:
+            from dlrover_tpu.brain.fleet_controller import (
+                FleetController,
+                LocalCapacityProvider,
+            )
+
+            self.capacity_provider = LocalCapacityProvider()
+            self.fleet_controller = FleetController(
+                ledger=self.goodput_ledger,
+                speed_monitor=self.speed_monitor,
+                steptrace=self.steptrace,
+                plan_calibration=self.plan_calibration,
+                rendezvous=training_mgr,
+                diagnosis=self.diagnosis_manager,
+                provider=self.capacity_provider)
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             rdzv_managers=self.rdzv_managers,
@@ -128,7 +151,12 @@ class JobMaster:
             tsdb=self.tsdb,
             plan_calibration=self.plan_calibration,
             steptrace=self.steptrace,
+            fleet_controller=self.fleet_controller,
         )
+        if self.fleet_controller is not None:
+            # a shed actuates through the EXISTING slice-unit drain
+            # chain (the servicer's notice-phase handler)
+            self.fleet_controller.shed_sink = self._controller_shed
         if self.diagnosis_manager is not None:
             # learned-discount feedback rides the diagnosis cadence,
             # not the per-report hot path (the medians only move as
@@ -281,6 +309,8 @@ class JobMaster:
                 self.coord_servicer.state_sink = self._maybe_snapshot
             if self.diagnosis_manager is not None:
                 self.diagnosis_manager.state_sink = self._maybe_snapshot
+            if self.fleet_controller is not None:
+                self.fleet_controller.state_sink = self._maybe_snapshot
             # the generation bump itself must be durable before the
             # first RPC is served
             self._maybe_snapshot()
@@ -320,6 +350,9 @@ class JobMaster:
         }
         if self.diagnosis_manager is not None:
             state["diagnosis"] = self.diagnosis_manager.export_state()
+        if self.fleet_controller is not None:
+            state["fleet_controller"] = \
+                self.fleet_controller.export_state()
         if self.job_manager is not None and \
                 hasattr(self.job_manager, "export_state"):
             state["job_manager"] = self.job_manager.export_state()
@@ -356,6 +389,13 @@ class JobMaster:
                 self.servicer.push_axis_discounts(discounts)
         if self.diagnosis_manager is not None and "diagnosis" in state:
             self.diagnosis_manager.restore_state(state["diagnosis"])
+        if self.fleet_controller is not None and \
+                "fleet_controller" in state:
+            # a promoted standby inherits decision history, cooldowns,
+            # quarantines and any open rollback watch — the guardrails
+            # must survive failover
+            self.fleet_controller.restore_state(
+                state["fleet_controller"])
         if self.job_manager is not None and "job_manager" in state and \
                 hasattr(self.job_manager, "restore_state"):
             self.job_manager.restore_state(state["job_manager"])
@@ -485,8 +525,31 @@ class JobMaster:
         if hasattr(training, "restart_shard"):
             chaos.shard_kill_fn = training.restart_shard
             chaos.shard_wedge_fn = training.wedge_shard
+        if self.capacity_provider is not None:
+            # the preemptible-market faults (offer:slice:+k@step,
+            # revoke:slice:S@step) feed the local capacity provider —
+            # the fleet controller's spot market in-process
+            chaos.offer_fn = self.capacity_provider.offer
+            chaos.revoke_fn = self.capacity_provider.revoke
         if chaos.faults:
             self.servicer.master_chaos = chaos
+
+    def _controller_shed(self, rank: int, deadline: float,
+                         reason: str) -> None:
+        """Fleet-controller shed actuator: a synthetic advance-notice
+        drain through the servicer's EXISTING slice-unit chain. The
+        notice rank itself also gets a save-and-exit drain action — in
+        a real preemption the OS notice file drives its exit, but a
+        controller-initiated shed has no notice file, so the action
+        queue carries the order instead."""
+        from dlrover_tpu.common import messages as msg
+
+        if self.diagnosis_manager is not None:
+            self.diagnosis_manager.request_drain(
+                [rank], deadline, reason=reason)
+        self.servicer._handle_drain(msg.DrainReport(
+            node_rank=rank, phase="notice", deadline=deadline,
+            reason=reason))
 
     def _attach_optimization(self, job_args, brain_addr: str) -> None:
         """Wire stats collection + resource optimization + auto-scaling
@@ -556,6 +619,8 @@ class JobMaster:
         self.task_manager.start_timeout_recovery()
         if self.diagnosis_manager is not None:
             self.diagnosis_manager.start()
+        if self.fleet_controller is not None:
+            self.fleet_controller.start()
         if self.tsdb_collector is not None:
             self.tsdb_collector.start()
         self._start_metrics_exporter()
@@ -711,6 +776,17 @@ class JobMaster:
                 self.auto_scaler.stop()
             if self.diagnosis_manager is not None:
                 self.diagnosis_manager.stop()
+            if self.fleet_controller is not None:
+                self.fleet_controller.stop()
+                try:
+                    # the decision history rides in the dump so
+                    # `tools/diagnose.py --flight` renders the exact
+                    # payload the live RPC served
+                    obs.get_flight_recorder().record_event(
+                        "autoscale",
+                        status=self.fleet_controller.status())
+                except Exception:  # noqa: BLE001 — the dump must land
+                    logger.exception("autoscale flight snapshot failed")
             if self.job_manager is not None:
                 self.job_manager.stop()
             if self._metrics_server is not None:

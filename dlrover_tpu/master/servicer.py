@@ -67,6 +67,7 @@ class MasterServicer:
         tsdb=None,
         plan_calibration=None,
         steptrace=None,
+        fleet_controller=None,
     ):
         self.task_manager = task_manager or TaskManager()
         self.rdzv_managers: Dict[str, RendezvousManager] = rdzv_managers or {
@@ -97,6 +98,10 @@ class MasterServicer:
         # fed batched per-step records from telemetry reports, queried
         # by tools/steptrace.py + top.py
         self.steptrace = steptrace
+        # optional: the goodput-optimal fleet controller
+        # (brain/fleet_controller.py) — queried by tools through the
+        # AutoscaleStatusRequest RPC; its loop runs on its own thread
+        self.fleet_controller = fleet_controller
         self._pushed_discounts: Dict[str, float] = {}
         # the tuned config is read on RPC threads and merged from the
         # auto-scaler thread: every access goes through _paral_lock or
@@ -218,6 +223,13 @@ class MasterServicer:
             return msg.GoodputReport(report_json=json.dumps(
                 self.goodput_ledger.snapshot(
                     window_s=request.window_s)))
+        if isinstance(request, msg.AutoscaleStatusRequest):
+            import json
+
+            if self.fleet_controller is None:
+                return msg.AutoscaleStatus(status_json="")
+            return msg.AutoscaleStatus(status_json=json.dumps(
+                self.fleet_controller.status()))
         if isinstance(request, msg.TimeSeriesQuery):
             import json
 

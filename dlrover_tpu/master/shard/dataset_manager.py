@@ -130,10 +130,20 @@ class BatchDatasetManager:
             logger.info("dataset %s: created %d tasks (epoch %d)",
                         self.dataset_name, len(shards), epoch)
 
+    def has_pending(self) -> bool:
+        """Dispatchable work exists now or after a splitter refill — the
+        gate for speed-weighted dispatch (TaskManager): a WAIT answer
+        may only defer a worker while there is something left to defer
+        it FROM, so end-of-epoch polls never count against its pace."""
+        return bool(self.todo) or not self._splitter.epoch_finished()
+
     # -- completion / failure ---------------------------------------------
     def report_task_status(self, task_id: int, success: bool
-                           ) -> Tuple[bool, Optional[Task]]:
-        """Returns (known, task). Failed tasks are requeued at the front."""
+                           ) -> Tuple[bool, Optional[DoingTask]]:
+        """Returns (known, doing). The popped DoingTask carries the
+        assignee and start time so the caller can feed per-rank task
+        latency into the worker-speed ledger. Failed tasks are requeued
+        at the front."""
         doing = self.doing.pop(task_id, None)
         if doing is None:
             return False, None
@@ -147,7 +157,7 @@ class BatchDatasetManager:
             self._completed_records += shard.end - shard.start
         else:
             self.todo.appendleft(doing.task)
-        return True, doing.task
+        return True, doing
 
     def recover_worker_tasks(self, worker_id: int) -> int:
         """Requeue every doing task of a dead worker (reference:

@@ -14,6 +14,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.messages import DatasetShardParams, Task
 from dlrover_tpu.master.shard.dataset_manager import (
@@ -31,6 +32,12 @@ class TaskManager:
         # rebuild each dataset's splitter (master/state_backend.py)
         self._params: Dict[str, DatasetShardParams] = {}
         self.speed_monitor = None   # wired by the job master
+        # speed-weighted dispatch (ctx.dispatch_speed_weighted):
+        # (dataset, worker) -> [served, polls] stride counters.
+        # Deliberately NOT exported — snapshotting poll counts would
+        # persist dispatch *rhythm*, not data position.
+        # graftlint: ephemeral(pace is re-learned from fresh speed evidence after a failover; data position lives in the datasets)
+        self._dispatch_counters: Dict[Tuple[str, int], list] = {}
 
     @property
     def mutation_count(self) -> int:
@@ -72,7 +79,38 @@ class TaskManager:
             dataset = self._datasets.get(dataset_name)
             if dataset is None:
                 return Task(task_id=-1, dataset_name=dataset_name)
+            if (Context.singleton().dispatch_speed_weighted
+                    and self._defer_for_speed(worker_id, dataset)):
+                return Task(task_id=-1, task_type=TaskType.WAIT,
+                            dataset_name=dataset_name)
             return dataset.get_task(worker_id)
+
+    def _defer_for_speed(self, worker_id: int, dataset) -> bool:
+        """(lock held) Deterministic stride deferral: rank r is served
+        iff served < polls x weight, with weight = its relative speed
+        (SpeedMonitor.relative_speeds) clamped to
+        [ctx.dispatch_weight_floor, 1.0]. Faster workers keep weight 1.0
+        and are never deferred; a 3x-slow rank at the default 0.25 floor
+        sees at most 3 consecutive WAITs, so progress is guaranteed and
+        epoch coverage stays exactly-once (a deferral never pops a
+        task, it only delays the pop). Polls count only while the
+        dataset still has dispatchable work — end-of-epoch WAIT/NONE
+        answers must not skew a rank's pace."""
+        if self.speed_monitor is None or not dataset.has_pending():
+            return False
+        scores = self.speed_monitor.relative_speeds()
+        score = scores.get(worker_id)
+        if score is None or len(scores) < 2:
+            return False   # no evidence, or no pack to pace against
+        weight = max(Context.singleton().dispatch_weight_floor,
+                     min(1.0, score))
+        counter = self._dispatch_counters.setdefault(
+            (dataset.dataset_name, worker_id), [0, 0])
+        counter[1] += 1
+        if counter[0] < counter[1] * weight:
+            counter[0] += 1
+            return False
+        return True
 
     def report_dataset_task(self, dataset_name: str, task_id: int,
                             success: bool) -> bool:
@@ -80,7 +118,19 @@ class TaskManager:
             dataset = self._datasets.get(dataset_name)
             if dataset is None:
                 return False
-            known, _task = dataset.report_task_status(task_id, success)
+            known, doing = dataset.report_task_status(task_id, success)
+            if (known and success and doing is not None
+                    and self.speed_monitor is not None):
+                # per-rank task latency feeds the worker-speed ledger
+                # even before any step report carries timing, so
+                # speed-weighted dispatch is not blind through the
+                # data-only warmup
+                shard = doing.task.shard
+                self.speed_monitor.collect_task_latency(
+                    doing.worker_id,
+                    time.time() - doing.start_time,
+                    (shard.end - shard.start) if shard else 0,
+                )
             return known
 
     # -- recovery ----------------------------------------------------------
@@ -93,6 +143,12 @@ class TaskManager:
                 if n:
                     logger.info("requeued %d tasks of dead worker %d (%s)",
                                 n, worker_id, dataset.dataset_name)
+            # its dispatch pace dies with it: a replacement rank must
+            # not inherit the dead worker's stride position
+            self._dispatch_counters = {
+                k: v for k, v in self._dispatch_counters.items()
+                if k[1] != worker_id
+            }
 
     def recover_timeout_tasks(self) -> None:
         timeout = Context.singleton().task_timeout_s

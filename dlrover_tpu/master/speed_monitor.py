@@ -18,6 +18,7 @@ goes through ``self._lock``; registry observes happen OUTSIDE the lock
 from __future__ import annotations
 
 import dataclasses
+import statistics
 import threading
 import time
 from collections import deque
@@ -62,6 +63,13 @@ class SpeedMonitor:
         self._worker_window = max(2, ctx.diagnosis_worker_window)
         self._worker_times: Dict[
             int, Deque[Tuple[float, float, float, float]]] = {}
+        # worker_id -> deque[(latency_s, records, ts)] from completed
+        # data-shard tasks (TaskManager.report_dataset_task): the only
+        # per-rank speed evidence during data-only warmup, before any
+        # step report carries timing — dispatch weighting must not fly
+        # blind there.
+        # graftlint: ephemeral(re-learned from the next task completions)
+        self._task_latency: Dict[int, Deque[Tuple[float, int, float]]] = {}
         # steps/s high-water mark over the job (throughput-collapse
         # baseline; survives window resets, cleared on restore)
         self._peak_speed = 0.0
@@ -187,6 +195,58 @@ class SpeedMonitor:
             self._publish_slice_gauges(slice_view)
         self.collect_global_step(step, timestamp)
 
+    def collect_task_latency(self, worker_id: int, latency_s: float,
+                             records: int,
+                             timestamp: Optional[float] = None) -> None:
+        """Per-rank data-shard completion latency, fed by
+        TaskManager.report_dataset_task on every successful shard.
+        Unlike step timing (gated on step_time_s > 0) this exists from
+        the very first completed shard, so speed-weighted dispatch has
+        evidence during the data-only warmup when no step report has
+        carried timing yet."""
+        if latency_s <= 0.0 or records <= 0:
+            return
+        timestamp = timestamp or time.time()
+        with self._lock:
+            window = self._task_latency.get(worker_id)
+            if window is None:
+                window = deque(maxlen=self._worker_window)
+                self._task_latency[worker_id] = window
+            window.append((latency_s, records, timestamp))
+
+    def relative_speeds(self) -> Dict[int, float]:
+        """Per-rank speed score: 1.0 = at the pack's pace, <1 slower,
+        >1 faster. Ranks with step-timing evidence are scored against
+        the fleet's median step time; ranks with ONLY task-latency
+        evidence (data-only warmup) against the median records/s of
+        that class. The two classes never share a denominator — a shard
+        fetch and a training step are not the same kind of second."""
+        with self._lock:
+            step_mean: Dict[int, float] = {}
+            for worker_id, window in self._worker_times.items():
+                times = [t for t, _, _, _ in window]
+                if times:
+                    step_mean[worker_id] = sum(times) / len(times)
+            task_rate: Dict[int, float] = {}
+            for worker_id, window in self._task_latency.items():
+                if worker_id in step_mean or not window:
+                    continue
+                lat = sum(entry[0] for entry in window)
+                recs = sum(entry[1] for entry in window)
+                if lat > 0.0 and recs > 0:
+                    task_rate[worker_id] = recs / lat
+        out: Dict[int, float] = {}
+        if step_mean:
+            med = statistics.median(step_mean.values())
+            if med > 0.0:
+                out.update({w: med / t for w, t in step_mean.items()
+                            if t > 0.0})
+        if task_rate:
+            med = statistics.median(task_rate.values())
+            if med > 0.0:
+                out.update({w: r / med for w, r in task_rate.items()})
+        return out
+
     # -- per-slice aggregates (multi-slice hierarchical DP) ----------------
     def set_slice_map(self, slice_map: Dict[int, int]) -> None:
         with self._lock:
@@ -291,6 +351,7 @@ class SpeedMonitor:
             self._skip_next_step_time = True
             self._peak_speed = 0.0
             self._worker_times.clear()
+            self._task_latency.clear()
 
     def _model_flops(self) -> float:
         with self._lock:
@@ -382,11 +443,13 @@ class SpeedMonitor:
         with self._lock:
             departed = ((set(self._worker_steps)
                          | set(self._worker_times)
+                         | set(self._task_latency)
                          | self._workers) - live_set)
             for worker_id in departed:
                 self._workers.discard(worker_id)
                 self._worker_steps.pop(worker_id, None)
                 self._worker_times.pop(worker_id, None)
+                self._task_latency.pop(worker_id, None)
             slice_view = (self._slice_rollup_locked()
                           if self._slice_map else None)
         if slice_view is not None and departed:
@@ -413,6 +476,7 @@ class SpeedMonitor:
             self._workers.discard(worker_id)
             self._worker_steps.pop(worker_id, None)
             self._worker_times.pop(worker_id, None)
+            self._task_latency.pop(worker_id, None)
 
     def is_hanged(self, hang_seconds: Optional[float] = None) -> bool:
         """No step progress for hang_seconds while training had started."""
@@ -452,6 +516,7 @@ class SpeedMonitor:
             self._skip_next_step_time = True
             self._peak_speed = 0.0
             self._worker_times.clear()
+            self._task_latency.clear()
 
     def reset_running_speed(self) -> None:
         """Call at membership change: old samples reflect the old world,
@@ -464,3 +529,4 @@ class SpeedMonitor:
             self._skip_next_step_time = True
             self._peak_speed = 0.0
             self._worker_times.clear()
+            self._task_latency.clear()

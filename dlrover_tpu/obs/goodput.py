@@ -395,8 +395,15 @@ class GoodputLedger:
 
     def note_elasticity_event(self, kind: str) -> None:
         """Name the trigger the NEXT world re-formation is attributed to
-        (drain / worker_lost / hang_restart / master_failover / scale)."""
+        (drain / worker_lost / hang_restart / autoscale / scale).
+
+        ``replan`` is the MECHANISM every world change rides through,
+        not a root cause: it only fills an empty slot, so an autoscale
+        claim (or a drain notice) that triggered the re-plan keeps the
+        attribution instead of being clobbered by its own side effect."""
         with self._lock:
+            if kind == "replan" and self._pending_reason:
+                return
             self._pending_reason = kind
 
     def observe_world(self, round_: int, world_size: int) -> None:

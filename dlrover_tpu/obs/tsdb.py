@@ -484,6 +484,8 @@ COLLECTED_PREFIXES = (
     "dlrover_tpu_node_cpu_percent",
     "dlrover_tpu_goodput_",
     "dlrover_tpu_elasticity_events_total",
+    "dlrover_tpu_capacity_offers_",     # open gauge + lifecycle counter
+    "dlrover_tpu_autoscale_",           # decisions + quarantined classes
 )
 
 # the dashboard's series set — the SINGLE source tools/top.py queries
@@ -502,6 +504,8 @@ DASHBOARD_SERIES = (
     "dlrover_tpu_steptrace_gating_rank",
     "dlrover_tpu_steptrace_gating_seconds",
     "dlrover_tpu_steptrace_cross_slice_wait_fraction",
+    "dlrover_tpu_capacity_offers_open",
+    "dlrover_tpu_autoscale_quarantined_classes",
 )
 
 

@@ -239,6 +239,24 @@ class ElasticTrainLoop:
             rank=int(os.environ.get(NodeEnv.NODE_RANK, "-1")))
         self._timeline_path = os.environ.get(NodeEnv.TIMELINE_FILE, "")
         self._timeline_exported_at = 0.0
+        # data-pipeline auto-tune (data/prefetch.py): fed the timeline's
+        # windowed data_wait fraction at each progress report; the input
+        # pipeline consumes `prefetch_tuner.depth_fn` (and its ring
+        # recommendation at rebuild boundaries) to stop starving steps
+        from dlrover_tpu.common.config import Context as _TuneCtx
+
+        if _TuneCtx.singleton().prefetch_autotune:
+            from dlrover_tpu.data.prefetch import PrefetchAutoTuner
+
+            self.prefetch_tuner = PrefetchAutoTuner()
+            obs.get_registry().gauge(
+                "dlrover_tpu_prefetch_depth",
+                "Auto-tuned device-prefetch depth (data/prefetch.py; "
+                "grows while the timeline's data_wait fraction exceeds "
+                "the tune threshold, decays when the pipeline is calm)",
+            ).set_function(self.prefetch_tuner.depth_fn)
+        else:
+            self.prefetch_tuner = None
         # per-step critical-path trace (obs/steptrace.py): one compact
         # record per step, clock-aligned against the master and batched
         # over the telemetry channel; the join-time probe anchors the
@@ -1323,6 +1341,9 @@ class ElasticTrainLoop:
             self._flops_per_token, self._peak_flops_total)
         degraded = (self._slice_sync.drain_unreported()
                     if self._slice_sync is not None else 0)
+        if self.prefetch_tuner is not None:
+            self.prefetch_tuner.observe(
+                stats.get("data_wait_fraction", -1.0))
         # device-truth HBM window peak (0 = backend has no memory
         # stats): drained per report so the master sees each window's
         # watermark, not a stale lifetime number

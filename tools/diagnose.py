@@ -308,6 +308,69 @@ def render_replans(payload: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_autoscale(status: Any) -> str:
+    """Fleet-controller section: the decision history (claim / shed /
+    hold / rollback, each with its reason and the ledger-priced
+    evidence), the open rollback watch, quarantined decision classes
+    and open capacity offers. Consumes exactly the
+    FleetController.status() dict — the AutoscaleStatusRequest RPC
+    (live) and the flight dump's ``autoscale`` event (postmortem) carry
+    the same shape, so both render byte-identical."""
+    if not status:
+        return "autoscale controller: no evidence"
+    decisions = status.get("decisions", [])
+    lines = [f"autoscale decisions: {len(decisions)}"]
+    ordered = sorted(decisions, key=lambda d: d.get("ts", 0.0))
+    if ordered:
+        t0 = ordered[0].get("ts", 0.0)
+        for decision in ordered:
+            evidence = decision.get("evidence") or {}
+            detail = " ".join(
+                f"{k}={v}" for k, v in sorted(evidence.items())
+                if not isinstance(v, (dict, list)))
+            lines.append(
+                "+{offset:8.1f}s  #{id:<3} {kind:<9} {outcome:<11} "
+                "{reason}".format(
+                    offset=decision.get("ts", 0.0) - t0,
+                    id=decision.get("id", "?"),
+                    kind=str(decision.get("kind", "?")),
+                    outcome=str(decision.get("outcome") or "-"),
+                    reason=str(decision.get("reason", ""))).rstrip())
+            if detail:
+                lines.append(f"{'':>12}  {detail}")
+    watch = status.get("watch")
+    if watch:
+        lines.append(
+            "  open rollback watch: decision #{id} ({kind}) baseline "
+            "goodput {base}".format(
+                id=watch.get("decision_id", "?"),
+                kind=watch.get("kind", "?"),
+                base=watch.get("baseline", "?")))
+    for kind, entry in sorted((status.get("quarantine") or {}).items()):
+        lines.append(
+            "  quarantined: {kind} for {rem}s (level {lvl})".format(
+                kind=kind, rem=entry.get("remaining_s", "?"),
+                lvl=entry.get("level", "?")))
+    for offer in status.get("offers") or []:
+        lines.append(
+            "  open offer {id}: {slices} slice(s) ttl={ttl}s".format(
+                id=offer.get("offer_id", "?"),
+                slices=offer.get("slices", "?"),
+                ttl=offer.get("ttl_s", "?")))
+    return "\n".join(lines)
+
+
+def autoscale_from_flight(payload: Dict[str, Any]) -> Any:
+    """The controller's stop-time status snapshot (the master records
+    one ``autoscale`` event at stop; the latest in the dump wins)."""
+    status = None
+    for record in payload.get("events", []):
+        if (record.get("kind") == "event"
+                and record.get("name") == "autoscale"):
+            status = record.get("attrs", {}).get("status") or status
+    return status
+
+
 def render_goodput(payload: Dict[str, Any]) -> str:
     """Goodput-ledger section of a flight dump: the bucket split plus
     the per-incarnation badput attribution (obs/goodput.py). Dumps
@@ -400,6 +463,7 @@ def main(argv=None) -> int:
             try:
                 print(render_reports(
                     client.get_diagnosis_reports(ns.limit)))
+                print(render_autoscale(client.get_autoscale_status()))
             finally:
                 client.close()
         except Exception as e:  # noqa: BLE001 — transport errors vary
@@ -417,6 +481,7 @@ def main(argv=None) -> int:
         print(render_slices(payload))
         print(render_controlplane(payload))
         print(render_replans(payload))
+        print(render_autoscale(autoscale_from_flight(payload)))
         print(render_goodput(payload))
     for path in ns.timeline:
         payload = _load_json(path)

@@ -19,7 +19,9 @@ per-slice step-time/MFU/goodput rollups, per-rank HBM watermark bars
 table (predicted vs measured step time per mesh — parallel/
 calibration.py), the steptrace critical-path panel (who gated the
 traced steps, on what phase — master/steptrace.py), control-plane
-health (slices formed / generations), recent diagnosis reports and the
+health (slices formed / generations), the fleet-controller panel
+(autoscale decisions, rollback watch, quarantines, open capacity
+offers — brain/fleet_controller.py), recent diagnosis reports and the
 resize/promotion history priced by the goodput ledger.
 
 Exit codes: 0 ok; 2 on unreadable inputs / unreachable master.
@@ -151,6 +153,10 @@ def collect_from_master(client, window_s: float = 900.0
         steptrace = client.query_steptrace(last_n=64)
     except Exception:  # noqa: BLE001 — older master / no assembler
         steptrace = {}
+    try:
+        autoscale = client.get_autoscale_status()
+    except Exception:  # noqa: BLE001 — older master / no controller
+        autoscale = {}
     return {
         "source": f"master {client.master_addr}",
         "series": series,
@@ -161,6 +167,7 @@ def collect_from_master(client, window_s: float = 900.0
         "diagnosis": diagnosis,
         "calibration": calibration,
         "steptrace": steptrace,
+        "autoscale": autoscale,
         "history": [],
     }
 
@@ -177,6 +184,7 @@ def collect_from_flight(payload: Dict[str, Any],
     stats: Dict[str, Any] = {}
     calibration: Dict[str, Any] = {}
     steptrace: Dict[str, Any] = {}
+    autoscale: Dict[str, Any] = {}
     diagnosis: List[Dict[str, Any]] = []
     history: List[Dict[str, Any]] = []
     for record in payload.get("events", []):
@@ -197,6 +205,10 @@ def collect_from_flight(payload: Dict[str, Any],
             }
         elif name == "steptrace":
             steptrace = attrs.get("snapshot") or {}
+        elif name == "autoscale":
+            # the controller's stop-time status snapshot (latest wins):
+            # same FleetController.status() shape the live RPC answers
+            autoscale = attrs.get("status") or autoscale
         elif name == "diagnosis":
             diagnosis.append({
                 "rule": attrs.get("rule", "?"),
@@ -220,6 +232,7 @@ def collect_from_flight(payload: Dict[str, Any],
         "diagnosis": diagnosis[-8:],
         "calibration": calibration,
         "steptrace": steptrace,
+        "autoscale": autoscale,
         "history": history,
     }
 
@@ -396,6 +409,51 @@ def render_critical_path(data: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def render_autoscale_panel(data: Dict[str, Any]) -> List[str]:
+    """Fleet-controller panel (brain/fleet_controller.py status shape,
+    live RPC or flight ``autoscale`` event): the newest decisions with
+    outcome + reason, the open rollback watch, quarantined decision
+    classes and the open capacity offers."""
+    status = data.get("autoscale") or {}
+    decisions = status.get("decisions") or []
+    lines = [f"== fleet controller ({len(decisions)} decisions)"]
+    if not status:
+        lines.append("  (controller disabled / no evidence)")
+        return lines
+    ordered = sorted(decisions, key=lambda d: d.get("ts", 0.0))
+    if ordered:
+        t0 = ordered[0].get("ts", 0.0)
+        for decision in ordered[-6:]:
+            evidence = decision.get("evidence") or {}
+            priced = evidence.get("actuation_cost_s")
+            cost = (f" cost={float(priced):.1f}s"
+                    if priced is not None else "")
+            lines.append(
+                "  +{:7.1f}s #{:<3} {:<9} {:<11} {}{}".format(
+                    decision.get("ts", 0.0) - t0,
+                    decision.get("id", "?"),
+                    str(decision.get("kind", "?")),
+                    str(decision.get("outcome") or "-"),
+                    str(decision.get("reason", ""))[:70], cost).rstrip())
+    else:
+        lines.append("  (no decisions yet)")
+    watch = status.get("watch")
+    if watch:
+        lines.append(
+            "  watching #{} ({}) vs baseline goodput {}".format(
+                watch.get("decision_id", "?"), watch.get("kind", "?"),
+                watch.get("baseline", "?")))
+    for kind, entry in sorted((status.get("quarantine") or {}).items()):
+        lines.append("  quarantined {} for {}s (level {})".format(
+            kind, entry.get("remaining_s", "?"),
+            entry.get("level", "?")))
+    for offer in status.get("offers") or []:
+        lines.append("  offer {}: {} slice(s) ttl={}s".format(
+            offer.get("offer_id", "?"), offer.get("slices", "?"),
+            offer.get("ttl_s", "?")))
+    return lines
+
+
 def render_diagnosis(data: Dict[str, Any]) -> List[str]:
     reports = data.get("diagnosis") or []
     lines = [f"== recent diagnosis ({len(reports)})"]
@@ -469,6 +527,7 @@ def render(data: Dict[str, Any]) -> str:
         render_hbm(data),
         render_calibration(data),
         render_critical_path(data),
+        render_autoscale_panel(data),
         render_diagnosis(data),
         render_history(data),
         render_store(data),
